@@ -1,0 +1,210 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Tasks, actors and a shared-memory object store (the core API of the
+reference, ``python/ray/_private/worker.py`` — init/get/put/wait/remote),
+plus JAX/XLA-idiomatic ML layers: device-mesh collectives over ICI
+(``ray_tpu.comm``), sharded models (``ray_tpu.models``), parallelism rules
+(``ray_tpu.parallel``), trainers/tuners/data/serving (``ray_tpu.train`` …).
+
+Heavy JAX modules are imported lazily — the core runtime has no JAX
+dependency so worker processes stay lightweight.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from . import exceptions  # noqa: F401
+from ._private import context as _ctx
+from ._private import protocol as _P
+from ._private.client import CoreClient
+from ._private.config import CONFIG
+from ._private.gcs import GlobalControlPlane, JobRecord
+from ._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID  # noqa: F401
+from ._private.node import NodeService
+from ._private.object_ref import ObjectRef
+from .api import ActorClass, ActorHandle, RemoteFunction, method, remote  # noqa: F401
+from .runtime_context import get_runtime_context  # noqa: F401
+
+__version__ = "0.1.0"
+
+_global_node: Optional[NodeService] = None
+_global_gcs: Optional[GlobalControlPlane] = None
+_session_dir: Optional[str] = None
+_owns_cluster = False
+
+
+def init(address: Optional[Any] = None,
+         num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default",
+         object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = False,
+         _system_config: Optional[Dict[str, Any]] = None) -> None:
+    """Start a local node (head) and connect, or connect to an existing
+    in-process cluster (pass a ``cluster_utils.Cluster``).
+
+    Reference analogue: ``ray.init`` (``_private/worker.py:1139``) — the
+    local-bootstrap path spawns the control plane + node service + worker
+    pool; here they live in this process with workers as subprocesses.
+    """
+    global _global_node, _global_gcs, _session_dir, _owns_cluster
+    if _ctx.current_client is not None:
+        if ignore_reinit_error:
+            return
+        raise RuntimeError("ray_tpu.init() called twice; "
+                           "call ray_tpu.shutdown() first")
+    if _system_config:
+        CONFIG.reload(_system_config)
+
+    job_id = JobID.from_random()
+    if address is not None:
+        # attach to an in-process multi-node cluster (tests / tools)
+        from . import cluster_utils
+        if isinstance(address, cluster_utils.Cluster):
+            cluster = address
+            _global_gcs = cluster.gcs
+            _global_node = cluster.head
+            _session_dir = cluster.session_dir
+            _owns_cluster = False
+        else:
+            raise ValueError(f"unsupported address: {address!r}")
+    else:
+        _session_dir = tempfile.mkdtemp(prefix="rtpu_session_")
+        _global_gcs = GlobalControlPlane()
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                    else os.cpu_count() or 4))
+        if num_tpus is not None:
+            res.setdefault("TPU", float(num_tpus))
+        elif "TPU" not in res:
+            detected = _detect_tpus()
+            if detected:
+                res["TPU"] = float(detected)
+        if object_store_memory:
+            CONFIG._values["object_store_memory_mb"] = (
+                object_store_memory // (1 << 20))
+        _global_node = NodeService(_global_gcs, _session_dir, res)
+        _global_node.start()
+        _owns_cluster = True
+
+    conn = _P.connect_unix(_global_node.socket_path)
+    client = CoreClient(conn, job_id, WorkerID.from_random(), _P.KIND_DRIVER)
+    conn.send((_P.REGISTER, (_P.KIND_DRIVER, client.worker_id.binary(),
+                             os.getpid())))
+    client.start_reader()
+    client.namespace = namespace
+    _ctx.current_client = client
+    _global_gcs.register_job(JobRecord(job_id=job_id, driver_pid=os.getpid(),
+                                       start_time=time.time()))
+    atexit.register(shutdown)
+
+
+def _detect_tpus() -> int:
+    """TPU autodetection as a first-class resource (north-star requirement;
+    reference analogue: ``_private/accelerator.py:38-45``)."""
+    chips = os.environ.get("TPU_CHIPS")
+    if chips:
+        return int(chips)
+    # visible TPU chips via /dev (TPU VMs expose accel devices)
+    count = 0
+    for i in range(8):
+        if os.path.exists(f"/dev/accel{i}") or os.path.exists(f"/dev/vfio/{i}"):
+            count += 1
+    return count
+
+
+def is_initialized() -> bool:
+    return _ctx.current_client is not None
+
+
+def shutdown() -> None:
+    global _global_node, _global_gcs, _session_dir, _owns_cluster
+    client = _ctx.current_client
+    if client is None:
+        return
+    _ctx.current_client = None
+    try:
+        client.close()
+    except Exception:
+        pass
+    if _owns_cluster and _global_node is not None:
+        _global_node.stop()
+        if _session_dir:
+            import shutil
+            shutil.rmtree(_session_dir, ignore_errors=True)
+    _global_node = None
+    _global_gcs = None
+    _session_dir = None
+    _owns_cluster = False
+    atexit.unregister(shutdown)
+
+
+def put(value: Any) -> ObjectRef:
+    """Store a value in the object store (reference: ``worker.py:2590``)."""
+    return _ctx.require_client().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    """Fetch object values, blocking (reference: ``worker.py:2475``)."""
+    client = _ctx.require_client()
+    if isinstance(refs, ObjectRef):
+        return client.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
+    if not refs:
+        return []
+    return client.get(list(refs), timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    """Wait for ``num_returns`` of ``refs`` to complete (reference:
+    ``worker.py:2653``)."""
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return _ctx.require_client().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    """Forcibly terminate an actor (reference: ``ray.kill``)."""
+    _ctx.require_client().kill_actor(actor.actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Cancel the task that produces ``ref`` (reference: ``ray.cancel``)."""
+    _ctx.require_client().cancel_task(ref.task_id(), force)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    """Look up a named actor (reference: ``worker.py:2784``)."""
+    info = _ctx.require_client().get_named_actor(name, namespace)
+    if info is None:
+        raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
+    return ActorHandle(info["actor_id"], info["name"])
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    _ctx.require_client().free(list(refs))
+
+
+def nodes() -> List[dict]:
+    return _ctx.require_client().cluster_info("nodes")
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _ctx.require_client().cluster_info("resources_total")
+
+
+def available_resources() -> Dict[str, float]:
+    return _ctx.require_client().cluster_info("resources_available")

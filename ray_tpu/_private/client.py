@@ -1,0 +1,277 @@
+"""CoreClient: the per-process runtime connecting to the node service.
+
+Equivalent role to the reference's worker-side ``CoreWorker``
+(``src/ray/core_worker/core_worker.h:285`` — Submit/Get/Put/Wait) plus the
+Cython binding (``python/ray/_raylet.pyx:2947``). One instance per process:
+the driver creates one in ``init()``; every worker process creates one at
+registration. Request/reply correlation lives here; object payloads are
+loaded zero-copy through ``ObjectReader``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import exceptions
+from . import protocol as P
+from .config import CONFIG
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .object_ref import ObjectRef
+from .object_store import ObjectMeta, ObjectReader, create_segment
+from . import serialization as ser
+
+
+class CoreClient:
+    def __init__(self, conn: P.Connection, job_id: JobID,
+                 worker_id: WorkerID, kind: int):
+        self.conn = conn
+        self.job_id = job_id
+        self.worker_id = worker_id
+        self.kind = kind
+        self.reader = ObjectReader()
+        self._futures: Dict[int, Future] = {}
+        self._req_lock = threading.Lock()
+        self._next_req = 1
+        self._registered_fns: set = set()
+        self._reader_thread: Optional[threading.Thread] = None
+        self._closed = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def start_reader(self) -> None:
+        """Driver mode: own the receive loop. Workers route replies here
+        from their main loop instead."""
+        t = threading.Thread(target=self._read_loop, name="rtpu-client-reader",
+                             daemon=True)
+        t.start()
+        self._reader_thread = t
+
+    def _read_loop(self) -> None:
+        while True:
+            msg = self.conn.recv()
+            if msg is None:
+                self._fail_all(ConnectionError("lost connection to node"))
+                return
+            self.handle_message(*msg)
+
+    def handle_message(self, op: int, payload: Any) -> None:
+        if op in (P.GET_REPLY, P.KV_REPLY, P.NAMED_ACTOR_REPLY,
+                  P.FUNCTION_REPLY, P.INFO_REPLY):
+            req_id, value = payload
+            fut = self._futures.pop(req_id, None)
+            if fut is not None:
+                fut.set_result(value)
+        elif op == P.WAIT_REPLY:
+            req_id, ready, pending = payload
+            fut = self._futures.pop(req_id, None)
+            if fut is not None:
+                fut.set_result((ready, pending))
+        elif op == P.ERROR_REPLY:
+            req_id, err = payload
+            fut = self._futures.pop(req_id, None)
+            if fut is not None:
+                fut.set_exception(ser.from_bytes(err))
+        elif op == P.SHUTDOWN:
+            self._fail_all(ConnectionError("node shutting down"))
+
+    def _fail_all(self, exc: Exception) -> None:
+        self._closed.set()
+        for fut in list(self._futures.values()):
+            if not fut.done():
+                fut.set_exception(exc)
+        self._futures.clear()
+
+    def close(self) -> None:
+        self._closed.set()
+        self.reader.close()
+        self.conn.close()
+
+    # ------------------------------------------------------------- plumbing
+    def _request(self, op: int, make_payload) -> Future:
+        with self._req_lock:
+            req_id = self._next_req
+            self._next_req += 1
+        fut: Future = Future()
+        self._futures[req_id] = fut
+        self.conn.send((op, make_payload(req_id)))
+        return fut
+
+    def _send(self, op: int, payload: Any) -> None:
+        self.conn.send((op, payload))
+
+    # ------------------------------------------------------------- objects
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.worker_id)
+        meta = self._store_value(oid, value)
+        self._send(P.PUT_OBJECT, meta)
+        return ObjectRef(oid)
+
+    def _store_value(self, oid: ObjectID, value: Any) -> ObjectMeta:
+        """Serialize a value; small inline, large into a fresh shm segment."""
+        smeta, views = ser.serialize(value)
+        total = ser.serialized_size(smeta, views)
+        if total <= CONFIG.max_inline_object_bytes:
+            out = bytearray(total)
+            ser.write_to(memoryview(out), smeta, views)
+            return ObjectMeta(object_id=oid, size=total, inline=bytes(out))
+        seg = create_segment(oid, total)
+        ser.write_to(seg.buf, smeta, views)
+        name = seg.name
+        seg.close()
+        return ObjectMeta(object_id=oid, size=total, shm_name=name)
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        ids = [r.id for r in refs]
+        fut = self._request(P.GET_OBJECTS,
+                            lambda rid: (rid, ids, timeout))
+        metas = fut.result()
+        return [self.reader.load(m) for m in metas]
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        ids = [r.id for r in refs]
+        fut = self._request(P.WAIT_OBJECTS,
+                            lambda rid: (rid, ids, num_returns, timeout))
+        ready_ids, pending_ids = fut.result()
+        ready_set = set(ready_ids)
+        ready = [r for r in refs if r.id in ready_set]
+        pending = [r for r in refs if r.id not in ready_set]
+        return ready, pending
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        self._send(P.FREE_OBJECTS, [r.id for r in refs])
+
+    def as_future(self, ref: ObjectRef) -> Future:
+        out: Future = Future()
+
+        def _resolve(fut: Future):
+            try:
+                metas = fut.result()
+                out.set_result(self.reader.load(metas[0]))
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        inner = self._request(P.GET_OBJECTS,
+                              lambda rid: (rid, [ref.id], None))
+        inner.add_done_callback(_resolve)
+        return out
+
+    # ---------------------------------------------------------------- args
+    def pack_args(self, args: tuple, kwargs: dict):
+        packed = [self._pack_one(a) for a in args]
+        pkw = {k: self._pack_one(v) for k, v in kwargs.items()}
+        return packed, pkw
+
+    def _pack_one(self, value: Any) -> Tuple[str, Any]:
+        if isinstance(value, ObjectRef):
+            return ("r", value.id)
+        smeta, views = ser.serialize(value)
+        total = ser.serialized_size(smeta, views)
+        if total <= CONFIG.max_inline_object_bytes:
+            out = bytearray(total)
+            ser.write_to(memoryview(out), smeta, views)
+            return ("v", bytes(out))
+        # large argument: implicit put, pass by reference
+        oid = ObjectID.for_put(self.worker_id)
+        seg = create_segment(oid, total)
+        ser.write_to(seg.buf, smeta, views)
+        name = seg.name
+        seg.close()
+        self._send(P.PUT_OBJECT, ObjectMeta(object_id=oid, size=total,
+                                            shm_name=name))
+        return ("r", oid)
+
+    # ---------------------------------------------------------------- tasks
+    def ensure_function(self, function_id: bytes, blob_fn) -> None:
+        if function_id in self._registered_fns:
+            return
+        self._send(P.KV_PUT, (b"fn:" + function_id, blob_fn(), False))
+        self._registered_fns.add(function_id)
+
+    def submit_task(self, function_id: bytes, name: str, args, kwargs,
+                    num_returns: int, resources: Dict[str, float],
+                    max_retries: int, scheduling_strategy=None,
+                    retry_exceptions: bool = False) -> List[ObjectRef]:
+        task_id = TaskID.for_job(self.job_id)
+        packed, pkw = self.pack_args(args, kwargs)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        spec = P.TaskSpec(
+            task_id=task_id, job_id=self.job_id, name=name,
+            function_id=function_id, args=packed, kwargs=pkw,
+            num_returns=num_returns, return_ids=return_ids,
+            resources=resources, max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy,
+            owner_id=self.worker_id.binary())
+        self._send(P.SUBMIT_TASK, spec)
+        return [ObjectRef(oid) for oid in return_ids]
+
+    def create_actor(self, spec: P.ActorSpec) -> None:
+        self._send(P.CREATE_ACTOR, spec)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args, kwargs, num_returns: int, seq_no: int,
+                          name: str = "") -> List[ObjectRef]:
+        task_id = TaskID.for_job(self.job_id)
+        packed, pkw = self.pack_args(args, kwargs)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        spec = P.TaskSpec(
+            task_id=task_id, job_id=self.job_id,
+            name=name or method_name, function_id=b"",
+            args=packed, kwargs=pkw, num_returns=num_returns,
+            return_ids=return_ids, resources={},
+            actor_id=actor_id, method_name=method_name, seq_no=seq_no,
+            owner_id=self.worker_id.binary())
+        self._send(P.SUBMIT_ACTOR_TASK, spec)
+        return [ObjectRef(oid) for oid in return_ids]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        self._send(P.KILL_ACTOR, (actor_id, no_restart))
+
+    def cancel_task(self, task_id: TaskID, force: bool) -> None:
+        self._send(P.CANCEL_TASK, (task_id, force))
+
+    def get_named_actor(self, name: str, namespace: str) -> Optional[dict]:
+        fut = self._request(P.GET_NAMED_ACTOR,
+                            lambda rid: (rid, name, namespace))
+        return fut.result()
+
+    def fetch_function(self, function_id: bytes) -> Optional[bytes]:
+        fut = self._request(P.FETCH_FUNCTION, lambda rid: (rid, function_id))
+        return fut.result()
+
+    # ------------------------------------------------------------------ kv
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> None:
+        self._send(P.KV_PUT, (key, value, overwrite))
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._request(P.KV_GET, lambda rid: (rid, key)).result()
+
+    def kv_del(self, key: bytes) -> None:
+        self._send(P.KV_DEL, key)
+
+    def kv_keys(self, prefix: bytes) -> List[bytes]:
+        return self._request(P.KV_KEYS, lambda rid: (rid, prefix)).result()
+
+    # ---------------------------------------------------------------- info
+    def cluster_info(self, what: str) -> Any:
+        return self._request(P.CLUSTER_INFO, lambda rid: (rid, what)).result()
+
+    def state_query(self, what: str, filters=None) -> Any:
+        return self._request(P.STATE_QUERY,
+                             lambda rid: (rid, what, filters)).result()
+
+    def create_placement_group(self, spec: P.PlacementGroupSpec):
+        return self._request(P.CREATE_PG, lambda rid: (rid, spec)).result()
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        self._send(P.REMOVE_PG, pg_id)
+
+
+def function_id_of(blob: bytes) -> bytes:
+    return hashlib.sha1(blob).digest()

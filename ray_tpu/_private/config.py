@@ -1,0 +1,103 @@
+"""Compiled-in configuration table, overridable via environment variables.
+
+Equivalent role to the reference's ``RAY_CONFIG`` table
+(``src/ray/common/ray_config_def.h``, 209 tunables overridable via ``RAY_*``
+env vars or a system-config JSON). Here every entry is a typed default that
+can be overridden by ``RTPU_<NAME>`` in the environment or by passing
+``_system_config={...}`` to ``init()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RTPU_"
+
+# name -> (type, default, help)
+_CONFIG_DEFS: Dict[str, tuple] = {
+    # --- object store ---
+    "object_store_memory_mb": (int, 2048, "shm budget for the local object store"),
+    "max_inline_object_bytes": (int, 100 * 1024,
+                                "results <= this are carried inline in RPC replies "
+                                "(reference: task_rpc_inlined_bytes_limit)"),
+    "object_spilling_threshold": (float, 0.8,
+                                  "fraction of store memory above which primary "
+                                  "copies are spilled to disk"),
+    "spill_directory": (str, "", "directory for spilled objects (default: session dir)"),
+    # --- scheduler ---
+    "scheduler_spread_threshold": (float, 0.5,
+                                   "hybrid policy: pack below this node utilization, "
+                                   "spread above (reference: scheduler_spread_threshold)"),
+    "scheduler_top_k_fraction": (float, 0.2,
+                                 "hybrid policy: random choice among best k nodes"),
+    "worker_lease_timeout_s": (float, 30.0, "lease request timeout"),
+    # --- worker pool ---
+    "num_prestart_workers": (int, 0, "workers to pre-start at node boot (0 = num_cpus)"),
+    "idle_worker_killing_time_s": (float, 300.0, "kill idle workers after this long"),
+    "worker_register_timeout_s": (float, 30.0, "worker registration handshake timeout"),
+    "maximum_startup_concurrency": (int, 16, "max concurrent worker process launches"),
+    # --- health / failure ---
+    "health_check_period_ms": (int, 3000,
+                               "control-plane liveness ping period "
+                               "(reference: ray_config_def.h:815)"),
+    "health_check_failure_threshold": (int, 5,
+                                       "consecutive missed pings before a node is dead"),
+    "task_max_retries_default": (int, 3, "default retries for retriable tasks"),
+    "actor_max_restarts_default": (int, 0, "default actor restarts"),
+    # --- task events / observability ---
+    "task_events_buffer_size": (int, 10000, "ring buffer of task state events"),
+    "metrics_report_interval_ms": (int, 5000, "metrics flush period"),
+    # --- protocol ---
+    "rpc_inline_chunk_bytes": (int, 1 << 20, "frame chunking for large messages"),
+    "grpc_equivalent_port": (int, 0, "tcp port for the head control plane (0 = unix socket)"),
+    # --- lineage ---
+    "max_lineage_bytes": (int, 100 * (1 << 20),
+                          "lineage footprint cap (reference: task_manager.h:180)"),
+    # --- logging ---
+    "log_to_driver": (bool, True, "forward worker stdout/stderr to the driver"),
+}
+
+
+class _Config:
+    """Process-wide config singleton. Read via attribute access."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self.reload()
+
+    def reload(self, system_config: Dict[str, Any] | None = None) -> None:
+        values: Dict[str, Any] = {}
+        for name, (typ, default, _help) in _CONFIG_DEFS.items():
+            raw = os.environ.get(_ENV_PREFIX + name.upper())
+            if raw is not None:
+                values[name] = self._parse(typ, raw)
+            else:
+                values[name] = default
+        if system_config:
+            for key, val in system_config.items():
+                if key not in _CONFIG_DEFS:
+                    raise ValueError(f"unknown config key: {key}")
+                values[key] = val
+        self._values = values
+
+    @staticmethod
+    def _parse(typ, raw: str):
+        if typ is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        if typ in (int, float, str):
+            return typ(raw)
+        return json.loads(raw)
+
+    def __getattr__(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def dump(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+CONFIG = _Config()

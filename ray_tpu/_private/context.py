@@ -1,0 +1,21 @@
+"""Process-global runtime context (driver or worker)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# The connected CoreClient for this process (driver after init(), worker
+# after registration). Reference analogue: ray._private.worker.global_worker.
+current_client: Optional[Any] = None
+
+# Set inside a worker process while executing a task.
+current_task_id = None
+current_actor_id = None
+in_worker: bool = False
+
+
+def require_client():
+    if current_client is None:
+        raise RuntimeError(
+            "ray_tpu is not initialized; call ray_tpu.init() first")
+    return current_client

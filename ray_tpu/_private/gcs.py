@@ -1,0 +1,266 @@
+"""Control plane: cluster membership, actor/job/PG registries, KV, directory.
+
+Equivalent role to the reference's GCS server (``src/ray/gcs/gcs_server/`` —
+GcsNodeManager, GcsActorManager, GcsPlacementGroupManager, GcsKVManager,
+GcsTaskManager) plus the ownership-based object directory
+(``object_manager/ownership_based_object_directory.h``). In this build the
+control plane is an in-process, thread-safe object: on a single host it is
+embedded in the node service; an in-process multi-node cluster
+(``ray_tpu.cluster_utils.Cluster``) shares one instance between node
+services, mirroring the reference's single-GCS topology. Cross-host
+deployment puts this behind the same framed-socket RPC used everywhere else.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .config import CONFIG
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+from .object_store import ObjectMeta
+from .protocol import ActorSpec, PlacementGroupSpec
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str                      # unix socket path of its service
+    resources_total: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    # in-process shortcut to the NodeService (same-process multi-node cluster)
+    service: Any = None
+
+
+@dataclass
+class ActorRecord:
+    spec: ActorSpec
+    state: str = ACTOR_PENDING
+    node_id: Optional[NodeID] = None
+    num_restarts: int = 0
+    death_reason: str = ""
+
+
+@dataclass
+class JobRecord:
+    job_id: JobID
+    driver_pid: int
+    start_time: float
+    end_time: Optional[float] = None
+
+
+@dataclass
+class TaskEvent:
+    """One task state transition, kept in a bounded ring for the state API
+    (reference: ``GcsTaskManager``, ``gcs_task_manager.h:61``)."""
+
+    task_id: TaskID
+    name: str
+    state: str
+    node_id: Optional[NodeID]
+    timestamp: float
+    is_actor_task: bool = False
+
+
+class GlobalControlPlane:
+    """Thread-safe cluster-wide registries."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.jobs: Dict[JobID, JobRecord] = {}
+        self.kv: Dict[bytes, bytes] = {}
+        self.placement_groups: Dict[PlacementGroupID, dict] = {}
+        # object directory: object -> (node_id, meta)
+        self.directory: Dict[ObjectID, Tuple[NodeID, ObjectMeta]] = {}
+        self.task_events: deque = deque(maxlen=CONFIG.task_events_buffer_size)
+        self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
+
+    # ------------------------------------------------------------- nodes
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[info.node_id] = info
+        self.publish("NODE", {"node_id": info.node_id, "state": "ALIVE"})
+
+    def remove_node(self, node_id: NodeID, reason: str = "") -> None:
+        dead_actors: List[ActorID] = []
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None:
+                return
+            info.alive = False
+            # drop directory entries whose only location was this node
+            lost = [oid for oid, (nid, _) in self.directory.items()
+                    if nid == node_id]
+            for oid in lost:
+                del self.directory[oid]
+            for aid, rec in self.actors.items():
+                if rec.node_id == node_id and rec.state != ACTOR_DEAD:
+                    dead_actors.append(aid)
+        self.publish("NODE", {"node_id": node_id, "state": "DEAD",
+                              "reason": reason})
+        for aid in dead_actors:
+            self.set_actor_state(aid, ACTOR_DEAD,
+                                 reason=f"node {node_id} died")
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info:
+                info.last_heartbeat = time.monotonic()
+
+    def cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self.alive_nodes():
+            for k, v in n.resources_total.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    # ------------------------------------------------------------ actors
+    def register_actor(self, spec: ActorSpec) -> ActorRecord:
+        rec = ActorRecord(spec=spec)
+        with self._lock:
+            if spec.registered_name:
+                key = (spec.namespace, spec.registered_name)
+                if key in self.named_actors:
+                    raise ValueError(
+                        f"actor name {spec.registered_name!r} already taken "
+                        f"in namespace {spec.namespace!r}")
+                self.named_actors[key] = spec.actor_id
+            self.actors[spec.actor_id] = rec
+        return rec
+
+    def set_actor_state(self, actor_id: ActorID, state: str,
+                        node_id: Optional[NodeID] = None,
+                        reason: str = "") -> None:
+        with self._lock:
+            rec = self.actors.get(actor_id)
+            if rec is None:
+                return
+            rec.state = state
+            if node_id is not None:
+                rec.node_id = node_id
+            if reason:
+                rec.death_reason = reason
+            if state == ACTOR_DEAD and rec.spec.registered_name:
+                self.named_actors.pop(
+                    (rec.spec.namespace, rec.spec.registered_name), None)
+        self.publish("ACTOR", {"actor_id": actor_id, "state": state,
+                               "reason": reason})
+
+    def lookup_named_actor(self, name: str,
+                           namespace: str = "default") -> Optional[ActorRecord]:
+        with self._lock:
+            actor_id = self.named_actors.get((namespace, name))
+            return self.actors.get(actor_id) if actor_id else None
+
+    # -------------------------------------------------------------- jobs
+    def register_job(self, rec: JobRecord) -> None:
+        with self._lock:
+            self.jobs[rec.job_id] = rec
+
+    def finish_job(self, job_id: JobID) -> None:
+        with self._lock:
+            rec = self.jobs.get(job_id)
+            if rec:
+                rec.end_time = time.time()
+
+    # ---------------------------------------------------------------- kv
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> bool:
+        with self._lock:
+            if not overwrite and key in self.kv:
+                return False
+            self.kv[key] = value
+            return True
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self.kv.get(key)
+
+    def kv_del(self, key: bytes) -> None:
+        with self._lock:
+            self.kv.pop(key, None)
+
+    def kv_keys(self, prefix: bytes) -> List[bytes]:
+        with self._lock:
+            return [k for k in self.kv if k.startswith(prefix)]
+
+    # ---------------------------------------------------------- directory
+    def publish_location(self, object_id: ObjectID, node_id: NodeID,
+                         meta: ObjectMeta) -> None:
+        with self._lock:
+            self.directory[object_id] = (node_id, meta)
+
+    def lookup_location(
+            self, object_id: ObjectID) -> Optional[Tuple[NodeID, ObjectMeta]]:
+        with self._lock:
+            return self.directory.get(object_id)
+
+    def drop_location(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self.directory.pop(object_id, None)
+
+    # ----------------------------------------------------- placement groups
+    def register_pg(self, spec: PlacementGroupSpec,
+                    assignment: List[NodeID]) -> None:
+        with self._lock:
+            self.placement_groups[spec.pg_id] = {
+                "spec": spec, "state": PG_CREATED, "assignment": assignment,
+            }
+
+    def get_pg(self, pg_id: PlacementGroupID) -> Optional[dict]:
+        with self._lock:
+            return self.placement_groups.get(pg_id)
+
+    def remove_pg(self, pg_id: PlacementGroupID) -> Optional[dict]:
+        with self._lock:
+            rec = self.placement_groups.pop(pg_id, None)
+            if rec:
+                rec["state"] = PG_REMOVED
+            return rec
+
+    # ------------------------------------------------------------- events
+    def record_task_event(self, ev: TaskEvent) -> None:
+        with self._lock:
+            self.task_events.append(ev)
+
+    def list_task_events(self, limit: int = 1000) -> List[TaskEvent]:
+        with self._lock:
+            return list(self.task_events)[-limit:]
+
+    # ------------------------------------------------------------- pubsub
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        """In-process pubsub (reference analogue: ``src/ray/pubsub/`` long-poll
+        channels). Callbacks run on the publisher's thread; keep them cheap."""
+        with self._lock:
+            self._subscribers.setdefault(channel, []).append(callback)
+
+    def publish(self, channel: str, payload: Any) -> None:
+        with self._lock:
+            subs = list(self._subscribers.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(payload)
+            except Exception:
+                pass

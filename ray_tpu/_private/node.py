@@ -1,0 +1,1227 @@
+"""Per-node service: scheduler, worker pool, object directory, actor manager.
+
+Equivalent role to the reference's raylet (``src/ray/raylet/node_manager.h:125``
+— worker leasing, dependency management, dispatch) fused with the
+owner-side core-worker duties (``core_worker/task_manager.h:173`` — retries,
+``object_recovery_manager.h`` — failure handling). One service per node; a
+single dispatcher thread owns all mutable state (the reference gets the same
+discipline from its asio event loop); per-connection reader threads feed a
+queue. Workers are real OS processes talking framed messages over a unix
+socket; object payloads ride shared memory (``object_store.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .. import exceptions
+from . import protocol as P
+from . import scheduler as sched
+from .config import CONFIG
+from .gcs import (ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING,
+                  GlobalControlPlane, NodeInfo, TaskEvent)
+from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from .object_store import ObjectMeta, ObjectStore
+from .serialization import to_bytes
+
+_WORKER_STATES = ("STARTING", "IDLE", "BUSY", "ACTOR", "DEAD")
+
+
+@dataclass
+class _Worker:
+    worker_id: WorkerID
+    proc: Optional[subprocess.Popen] = None
+    conn: Optional[P.Connection] = None
+    conn_key: Optional[int] = None
+    state: str = "STARTING"
+    task: Optional["_TaskRecord"] = None
+    actor_id: Optional[ActorID] = None
+    started_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _TaskRecord:
+    spec: P.TaskSpec
+    kind: str = "task"                    # task | actor_create | actor_call
+    deps: Dict[ObjectID, ObjectMeta] = field(default_factory=dict)
+    remaining_deps: Set[ObjectID] = field(default_factory=set)
+    retries_left: int = 0
+    worker_id: Optional[WorkerID] = None
+    charge: Optional[Dict[str, float]] = None
+    pg_key: Optional[tuple] = None
+    actor_spec: Optional[P.ActorSpec] = None
+    cancelled: bool = False
+
+
+@dataclass
+class _OwnedTask:
+    """Owner-side record of a submitted task, for retry on node failure.
+
+    Reference analogue: ``TaskManager`` lineage entries
+    (``core_worker/task_manager.h:369`` RetryTaskIfPossible).
+    """
+
+    spec: P.TaskSpec
+    kind: str
+    retries_left: int
+    assigned_node: Optional[NodeID] = None
+    actor_spec: Optional[P.ActorSpec] = None
+    done: bool = False
+
+
+@dataclass
+class _Waiter:
+    req_id: int
+    conn_key: int
+    object_ids: List[ObjectID]
+    remaining: Set[ObjectID] = field(default_factory=set)
+    num_returns: int = 0                  # for WAIT; 0 means GET (need all)
+    timer: Optional[threading.Timer] = None
+    fired: bool = False
+
+
+class NodeService:
+    """One per node. ``head=True`` also hosts the control plane."""
+
+    def __init__(self, gcs: GlobalControlPlane, session_dir: str,
+                 resources: Dict[str, float], node_id: Optional[NodeID] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.gcs = gcs
+        self.node_id = node_id or NodeID.from_random()
+        self.session_dir = session_dir
+        os.makedirs(session_dir, exist_ok=True)
+        self.socket_path = os.path.join(
+            session_dir, f"node_{self.node_id.hex()[:12]}.sock")
+        self.store = ObjectStore(
+            spill_dir=os.path.join(session_dir, "spill", self.node_id.hex()[:12]))
+
+        self._res_lock = threading.Lock()
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.pg_reservations: Dict[tuple, Dict[str, float]] = {}
+        self.pg_bundle_total: Dict[tuple, Dict[str, float]] = {}
+
+        self._events: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._conns: Dict[int, P.Connection] = {}
+        self._conn_kind: Dict[int, int] = {}
+        self._conn_worker: Dict[int, WorkerID] = {}
+        self._next_conn_key = 1
+        self._workers: Dict[WorkerID, _Worker] = {}
+        self._idle: deque = deque()
+        self._num_starting = 0
+        self._max_workers = max(int(resources.get("CPU", 4)) * 2, 8)
+
+        self._pending: deque = deque()                    # ready-to-dispatch
+        self._waiting_deps: Dict[TaskID, _TaskRecord] = {}
+        self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
+        self._running: Dict[TaskID, _TaskRecord] = {}
+        self._owned: Dict[TaskID, _OwnedTask] = {}
+
+        self._actors: Dict[ActorID, dict] = {}            # local actor state
+        self._actor_queues: Dict[ActorID, deque] = {}
+
+        self._get_waiters: Dict[int, _Waiter] = {}
+        self._wait_waiters: Dict[int, _Waiter] = {}
+        self._obj_waiter_index: Dict[ObjectID, Set[int]] = {}
+        self._next_waiter = 1
+
+        self._stopped = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._listener: Optional[socket.socket] = None
+        self._driver_conn_keys: Set[int] = set()
+        self.dead = False
+
+        self._rng = random.Random(self.node_id.binary())
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self, labels: Optional[Dict[str, str]] = None) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(128)
+        self.gcs.register_node(NodeInfo(
+            node_id=self.node_id, address=self.socket_path,
+            resources_total=dict(self.resources_total),
+            labels=labels or {}, service=self))
+        self.gcs.subscribe("OBJECT", self._on_object_published)
+        self.gcs.subscribe("NODE", self._on_node_event)
+        self.gcs.subscribe("TASK_FINISHED", self._on_task_finished)
+        self.gcs.subscribe("ACTOR", self._on_actor_event)
+        t_acc = threading.Thread(target=self._accept_loop,
+                                 name=f"rtpu-accept-{self.node_id.hex()[:6]}",
+                                 daemon=True)
+        t_disp = threading.Thread(target=self._dispatch_loop,
+                                  name=f"rtpu-dispatch-{self.node_id.hex()[:6]}",
+                                  daemon=True)
+        t_acc.start()
+        t_disp.start()
+        self._threads += [t_acc, t_disp]
+
+    def stop(self, kill_workers: bool = True) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self.dead = True
+        self.gcs.remove_node(self.node_id, reason="node stopped")
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self._events.put(("stop",))
+        if kill_workers:
+            for w in list(self._workers.values()):
+                if w.proc is not None:
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+        for w in list(self._workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=5)
+                except Exception:
+                    pass
+        self.store.shutdown()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        """Simulate abrupt node failure (for chaos tests)."""
+        self.stop(kill_workers=True)
+
+    # ------------------------------------------------------ cross-thread API
+    def available_snapshot(self) -> Dict[str, float]:
+        with self._res_lock:
+            return dict(self.resources_available)
+
+    def reserve_bundle(self, pg_key: tuple, demand: Dict[str, float]) -> bool:
+        with self._res_lock:
+            if not sched.fits(self.resources_available, demand):
+                return False
+            sched.subtract(self.resources_available, demand)
+            self.pg_reservations[pg_key] = dict(demand)
+            self.pg_bundle_total[pg_key] = dict(demand)
+            return True
+
+    def release_bundle(self, pg_key: tuple) -> None:
+        with self._res_lock:
+            total = self.pg_bundle_total.pop(pg_key, None)
+            self.pg_reservations.pop(pg_key, None)
+            if total:
+                sched.add(self.resources_available, total)
+
+    def post_remote(self, item: tuple) -> None:
+        """Called by peer node services / cluster utilities."""
+        self._events.put(item)
+
+    # ------------------------------------------------------------- threads
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = P.Connection(sock)
+            key = self._next_conn_key
+            self._next_conn_key += 1
+            self._conns[key] = conn
+            t = threading.Thread(target=self._reader_loop, args=(key, conn),
+                                 daemon=True)
+            t.start()
+
+    def _reader_loop(self, key: int, conn: P.Connection) -> None:
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                self._events.put(("conn_closed", key))
+                return
+            self._events.put(("msg", key, msg))
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._events.get()
+            if item[0] == "stop":
+                return
+            try:
+                self._handle(item)
+            except Exception:
+                import traceback
+                traceback.print_exc(file=sys.stderr)
+
+    # ------------------------------------------------------------- handling
+    def _handle(self, item: tuple) -> None:
+        kind = item[0]
+        if kind == "msg":
+            _, key, (op, payload) = item
+            self._handle_msg(key, op, payload)
+        elif kind == "conn_closed":
+            self._on_conn_closed(item[1])
+        elif kind == "remote_task":
+            self._queue_local(item[1], "task")
+        elif kind == "remote_actor_create":
+            self._local_create_actor(item[1])
+        elif kind == "remote_actor_task":
+            self._local_actor_task(item[1])
+        elif kind == "remote_kill_actor":
+            self._local_kill_actor(item[1], item[2])
+        elif kind == "remote_cancel":
+            self._local_cancel(item[1], item[2])
+        elif kind == "object_ready":
+            self._on_object_ready(item[1], item[2])
+        elif kind == "node_dead":
+            self._on_node_dead(item[1])
+        elif kind == "task_finished":
+            self._owned.pop(item[1], None)
+        elif kind == "actor_dead":
+            self._on_remote_actor_dead(item[1], item[2])
+        elif kind == "timer":
+            item[1]()
+
+    def _handle_msg(self, key: int, op: int, payload: Any) -> None:
+        if op == P.REGISTER:
+            kind, worker_id, pid = payload
+            self._conn_kind[key] = kind
+            if kind == P.KIND_WORKER:
+                wid = WorkerID(worker_id)
+                self._conn_worker[key] = wid
+                w = self._workers.get(wid)
+                if w is None:
+                    w = _Worker(worker_id=wid)
+                    self._workers[wid] = w
+                w.conn = self._conns[key]
+                w.conn_key = key
+                self._num_starting = max(0, self._num_starting - 1)
+                if w.state == "STARTING":
+                    w.state = "IDLE"
+                    self._idle.append(wid)
+                self._dispatch()
+            else:
+                self._driver_conn_keys.add(key)
+        elif op == P.SUBMIT_TASK:
+            self._submit_task(payload)
+        elif op == P.CREATE_ACTOR:
+            self._create_actor(payload)
+        elif op == P.SUBMIT_ACTOR_TASK:
+            self._submit_actor_task(payload)
+        elif op == P.PUT_OBJECT:
+            self._seal_object(payload)
+        elif op == P.GET_OBJECTS:
+            self._get_objects(key, *payload)
+        elif op == P.WAIT_OBJECTS:
+            self._wait_objects(key, *payload)
+        elif op == P.FREE_OBJECTS:
+            for oid in payload:
+                self.gcs.drop_location(oid)
+            self.store.free(payload)
+        elif op == P.TASK_DONE:
+            self._task_done(key, *payload)
+        elif op == P.KILL_ACTOR:
+            self._kill_actor(*payload)
+        elif op == P.CANCEL_TASK:
+            self._cancel_task(*payload)
+        elif op == P.GET_NAMED_ACTOR:
+            req_id, name, namespace = payload
+            rec = self.gcs.lookup_named_actor(name, namespace)
+            info = None
+            if rec is not None and rec.state != ACTOR_DEAD:
+                info = {"actor_id": rec.spec.actor_id,
+                        "name": rec.spec.name,
+                        "is_async": rec.spec.is_async,
+                        "max_concurrency": rec.spec.max_concurrency}
+            self._reply(key, P.NAMED_ACTOR_REPLY, (req_id, info))
+        elif op == P.KV_PUT:
+            k, v, overwrite = payload
+            self.gcs.kv_put(k, v, overwrite)
+        elif op == P.KV_GET:
+            req_id, k = payload
+            self._reply(key, P.KV_REPLY, (req_id, self.gcs.kv_get(k)))
+        elif op == P.KV_DEL:
+            self.gcs.kv_del(payload)
+        elif op == P.KV_KEYS:
+            req_id, prefix = payload
+            self._reply(key, P.KV_REPLY, (req_id, self.gcs.kv_keys(prefix)))
+        elif op == P.FETCH_FUNCTION:
+            req_id, function_id = payload
+            blob = self.gcs.kv_get(b"fn:" + function_id)
+            self._reply(key, P.FUNCTION_REPLY, (req_id, blob))
+        elif op == P.CLUSTER_INFO:
+            req_id, what = payload
+            self._reply(key, P.INFO_REPLY, (req_id, self._cluster_info(what)))
+        elif op == P.CREATE_PG:
+            self._create_pg(key, payload)
+        elif op == P.REMOVE_PG:
+            self._remove_pg(payload)
+        elif op == P.ACTOR_EXIT:
+            actor_id, reason = payload
+            self._local_kill_actor(actor_id, True, reason=reason or "exit_actor")
+        elif op == P.STATE_QUERY:
+            req_id, what, filters = payload
+            self._reply(key, P.INFO_REPLY,
+                        (req_id, self._state_query(what, filters)))
+
+    def _reply(self, conn_key: int, op: int, payload: Any) -> None:
+        conn = self._conns.get(conn_key)
+        if conn is None:
+            return
+        try:
+            conn.send((op, payload))
+        except OSError:
+            pass
+
+    # ----------------------------------------------------------- submission
+    def _candidates(self):
+        out = []
+        for info in self.gcs.alive_nodes():
+            svc = info.service
+            if svc is None or svc.dead:
+                continue
+            out.append((info.node_id, dict(info.resources_total),
+                        svc.available_snapshot()))
+        return out
+
+    def _service_of(self, node_id: NodeID) -> Optional["NodeService"]:
+        info = self.gcs.nodes.get(node_id)
+        return info.service if info and info.alive else None
+
+    def _submit_task(self, spec: P.TaskSpec) -> None:
+        self._owned[spec.task_id] = _OwnedTask(
+            spec=spec, kind="task", retries_left=spec.max_retries)
+        self._route_task(spec)
+
+    def _route_task(self, spec: P.TaskSpec) -> None:
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
+            target = self._pg_target_node(strategy)
+        else:
+            target = sched.pick_node(spec.resources, strategy or sched.DEFAULT,
+                                     self._candidates(), self.node_id,
+                                     self._rng)
+        owned = self._owned.get(spec.task_id)
+        if target is None:
+            # Infeasible now; retry when cluster membership changes.
+            self._fail_returns(spec, RuntimeError(
+                f"no feasible node for resources {spec.resources}"))
+            return
+        if owned:
+            owned.assigned_node = target
+        if target == self.node_id:
+            self._queue_local(spec, "task")
+        else:
+            svc = self._service_of(target)
+            if svc is None:
+                self._fail_returns(spec, exceptions.WorkerCrashedError(
+                    "target node died before dispatch"))
+                return
+            svc.post_remote(("remote_task", spec))
+
+    def _pg_target_node(self, strategy) -> Optional[NodeID]:
+        pg = self.gcs.get_pg(strategy.pg_id())
+        if pg is None:
+            return None
+        idx = strategy.placement_group_bundle_index
+        assignment = pg["assignment"]
+        if idx is None or idx < 0:
+            idx = 0
+        if idx >= len(assignment):
+            return None
+        return assignment[idx]
+
+    def _queue_local(self, spec: P.TaskSpec, kind: str,
+                     actor_spec: Optional[P.ActorSpec] = None) -> None:
+        rec = _TaskRecord(spec=spec, kind=kind, actor_spec=actor_spec,
+                          retries_left=spec.max_retries)
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
+            rec.pg_key = (strategy.pg_id(),
+                          max(strategy.placement_group_bundle_index, 0))
+        self._record_event(spec, "PENDING_ARGS_AVAIL")
+        # resolve dependencies
+        for slot, val in list(spec.args) + list(spec.kwargs.values()):
+            if slot == "r":
+                self._add_dep(rec, val)
+        if rec.remaining_deps:
+            self._waiting_deps[spec.task_id] = rec
+        else:
+            self._pending.append(rec)
+            self._dispatch()
+
+    def _add_dep(self, rec: _TaskRecord, oid: ObjectID) -> None:
+        meta = self._lookup_object(oid)
+        if meta is not None:
+            rec.deps[oid] = meta
+        else:
+            rec.remaining_deps.add(oid)
+            self._dep_index.setdefault(oid, set()).add(rec.spec.task_id)
+
+    def _lookup_object(self, oid: ObjectID) -> Optional[ObjectMeta]:
+        meta = self.store.get_meta(oid)
+        if meta is not None:
+            return meta
+        loc = self.gcs.lookup_location(oid)
+        if loc is not None:
+            return loc[1]
+        return None
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self) -> None:
+        """Scan the local queue, dispatching every task whose resources and
+        worker are available (reference:
+        ``LocalTaskManager::DispatchScheduledTasksToWorkers``,
+        ``local_task_manager.cc:105``)."""
+        if not self._pending:
+            return
+        remaining = deque()
+        while self._pending:
+            rec = self._pending.popleft()
+            if rec.cancelled:
+                continue
+            if not self._try_acquire(rec):
+                remaining.append(rec)
+                continue
+            wid = self._acquire_worker()
+            if wid is None:
+                self._release_charge(rec)
+                remaining.append(rec)
+                self._maybe_spawn_worker()
+                break
+            self._assign(rec, wid)
+        self._pending.extend(remaining)
+
+    def _try_acquire(self, rec: _TaskRecord) -> bool:
+        demand = rec.spec.resources
+        with self._res_lock:
+            if rec.pg_key is not None:
+                pool = self.pg_reservations.get(rec.pg_key)
+                if pool is None or not sched.fits(pool, demand):
+                    return False
+                sched.subtract(pool, demand)
+            else:
+                if not sched.fits(self.resources_available, demand):
+                    return False
+                sched.subtract(self.resources_available, demand)
+        rec.charge = dict(demand)
+        return True
+
+    def _release_charge(self, rec: _TaskRecord) -> None:
+        if rec.charge is None:
+            return
+        with self._res_lock:
+            if rec.pg_key is not None:
+                pool = self.pg_reservations.get(rec.pg_key)
+                if pool is not None:
+                    sched.add(pool, rec.charge)
+            else:
+                sched.add(self.resources_available, rec.charge)
+        rec.charge = None
+
+    def _acquire_worker(self) -> Optional[WorkerID]:
+        while self._idle:
+            wid = self._idle.popleft()
+            w = self._workers.get(wid)
+            if w is not None and w.state == "IDLE":
+                return wid
+        return None
+
+    def _maybe_spawn_worker(self) -> None:
+        self._reap_startup_failures()
+        active = sum(1 for w in self._workers.values() if w.state != "DEAD")
+        if active >= self._max_workers:
+            return
+        if self._num_starting >= CONFIG.maximum_startup_concurrency:
+            return
+        self._spawn_worker()
+
+    def _reap_startup_failures(self) -> None:
+        """Workers that died before registering never produce a conn_closed
+        event; reap them here so startup slots aren't leaked forever."""
+        now = time.monotonic()
+        for wid, w in list(self._workers.items()):
+            if w.state != "STARTING" or w.proc is None:
+                continue
+            if (w.proc.poll() is not None
+                    or now - w.started_at > CONFIG.worker_register_timeout_s):
+                if w.proc.poll() is None:
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+                del self._workers[wid]
+                self._num_starting = max(0, self._num_starting - 1)
+
+    def _spawn_worker(self) -> WorkerID:
+        wid = WorkerID.from_random()
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{wid.hex()[:12]}.log"), "ab")
+        env = dict(os.environ)
+        env["RTPU_WORKER"] = "1"
+        # Workers never grab the TPU; the driver owns device compute. Also
+        # disable TPU-attach hooks in sitecustomize (saves ~2s/spawn).
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker",
+             self.socket_path, self.node_id.hex(), wid.hex()],
+            stdout=out, stderr=subprocess.STDOUT, env=env,
+            cwd=os.getcwd())
+        out.close()
+        self._workers[wid] = _Worker(worker_id=wid, proc=proc)
+        self._num_starting += 1
+        return wid
+
+    def _assign(self, rec: _TaskRecord, wid: WorkerID) -> None:
+        w = self._workers[wid]
+        w.state = "ACTOR" if rec.kind == "actor_create" else "BUSY"
+        w.task = rec
+        rec.worker_id = wid
+        if rec.kind == "actor_create":
+            w.actor_id = rec.actor_spec.actor_id
+            st = self._actors.get(rec.actor_spec.actor_id)
+            if st is not None:
+                st["worker_id"] = wid
+        self._running[rec.spec.task_id] = rec
+        self._record_event(rec.spec, "RUNNING")
+        for oid in rec.deps:
+            self.store.pin(oid)     # keep dep segments mapped while running
+        try:
+            w.conn.send((P.EXECUTE_TASK, (rec.kind, rec.spec, rec.deps,
+                                          rec.actor_spec)))
+        except OSError:
+            self._events.put(("conn_closed", w.conn_key))
+
+    # ------------------------------------------------------------ completion
+    def _task_done(self, conn_key: int, task_id, metas: List[ObjectMeta],
+                   error: Optional[bytes], kind: str) -> None:
+        rec = self._running.pop(task_id, None)
+        if rec is not None:
+            for oid in rec.deps:
+                self.store.unpin(oid)
+        for meta in metas:
+            self._seal_object(meta)
+        if rec is None:
+            return
+        self._record_event(rec.spec, "FINISHED" if error is None else "FAILED")
+        self.gcs.publish("TASK_FINISHED", {"task_id": task_id,
+                                           "ok": error is None})
+        w = self._workers.get(rec.worker_id) if rec.worker_id else None
+        if rec.kind == "actor_create":
+            self._actor_creation_done(rec, error)
+            return
+        self._release_charge(rec)
+        if w is not None and w.state == "BUSY":
+            w.state = "IDLE"
+            w.task = None
+            self._idle.append(w.worker_id)
+        if rec.kind == "actor_call" and w is not None:
+            w.task = None
+        self._dispatch()
+
+    def _seal_object(self, meta: ObjectMeta) -> None:
+        self.store.adopt(meta)
+        self.gcs.publish_location(meta.object_id, self.node_id, meta)
+        self.gcs.publish("OBJECT", (meta.object_id, meta))
+
+    def _on_object_published(self, payload) -> None:
+        oid, meta = payload
+        self._events.put(("object_ready", oid, meta))
+
+    def _on_object_ready(self, oid: ObjectID, meta: ObjectMeta) -> None:
+        # resolve task dependencies
+        for tid in self._dep_index.pop(oid, ()):  # noqa: B020
+            rec = self._waiting_deps.get(tid)
+            if rec is None:
+                continue
+            rec.deps[oid] = meta
+            rec.remaining_deps.discard(oid)
+            if not rec.remaining_deps:
+                del self._waiting_deps[tid]
+                if rec.kind == "actor_call_waiting":
+                    rec.kind = "actor_call"
+                    self._send_actor_call(rec)
+                else:
+                    self._pending.append(rec)
+        # resolve client waiters
+        for waiter_id in list(self._obj_waiter_index.pop(oid, ())):
+            waiter = (self._get_waiters.get(waiter_id)
+                      or self._wait_waiters.get(waiter_id))
+            if waiter is None:
+                continue
+            waiter.remaining.discard(oid)
+            self._maybe_fire_waiter(waiter_id, waiter)
+        self._dispatch()
+
+    def _fail_returns(self, spec: P.TaskSpec, exc: Exception) -> None:
+        err = to_bytes(exc)
+        for oid in spec.return_ids:
+            meta = ObjectMeta(object_id=oid, size=len(err), error=err)
+            self._seal_object(meta)
+        self.gcs.publish("TASK_FINISHED", {"task_id": spec.task_id,
+                                           "ok": False})
+
+    # ---------------------------------------------------------------- actors
+    def _create_actor(self, spec: P.ActorSpec) -> None:
+        try:
+            self.gcs.register_actor(spec)
+        except ValueError as e:
+            # duplicate named actor: surface the error through the
+            # creation ref instead of a half-registered phantom record
+            if spec.creation_return_id:
+                err = to_bytes(e)
+                self._seal_object(ObjectMeta(
+                    object_id=spec.creation_return_id, size=len(err),
+                    error=err))
+            return
+        self._owned[ActorTaskIds.creation_task(spec)] = _OwnedTask(
+            spec=self._creation_task_spec(spec), kind="actor_create",
+            retries_left=0, actor_spec=spec)
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
+            target = self._pg_target_node(strategy)
+        else:
+            target = sched.pick_node(spec.resources, strategy or sched.DEFAULT,
+                                     self._candidates(), self.node_id,
+                                     self._rng)
+        if target is None:
+            self.gcs.set_actor_state(spec.actor_id, ACTOR_DEAD,
+                                     reason="no feasible node")
+            if spec.creation_return_id:
+                err = to_bytes(exceptions.ActorDiedError(
+                    spec.actor_id, "no feasible node for actor resources"))
+                self._seal_object(ObjectMeta(
+                    object_id=spec.creation_return_id, size=len(err),
+                    error=err))
+            return
+        self.gcs.set_actor_state(spec.actor_id, ACTOR_PENDING, node_id=target)
+        if target == self.node_id:
+            self._local_create_actor(spec)
+        else:
+            self._service_of(target).post_remote(("remote_actor_create", spec))
+
+    def _creation_task_spec(self, spec: P.ActorSpec) -> P.TaskSpec:
+        return P.TaskSpec(
+            task_id=ActorTaskIds.creation_task(spec),
+            job_id=spec.job_id,
+            name=f"{spec.name}.__init__",
+            function_id=b"",
+            args=spec.args, kwargs=spec.kwargs,
+            num_returns=1,
+            return_ids=[spec.creation_return_id] if spec.creation_return_id else [],
+            resources=spec.resources,
+            scheduling_strategy=spec.scheduling_strategy)
+
+    def _local_create_actor(self, spec: P.ActorSpec) -> None:
+        self._actors[spec.actor_id] = {
+            "spec": spec, "worker_id": None, "state": ACTOR_PENDING,
+            "restarts_left": spec.max_restarts, "no_restart": False,
+        }
+        self._actor_queues.setdefault(spec.actor_id, deque())
+        tspec = self._creation_task_spec(spec)
+        self._queue_local(tspec, "actor_create", actor_spec=spec)
+
+    def _actor_creation_done(self, rec: _TaskRecord,
+                             error: Optional[bytes]) -> None:
+        spec = rec.actor_spec
+        st = self._actors.get(spec.actor_id)
+        if error is not None:
+            if st:
+                st["state"] = ACTOR_DEAD
+            self._release_charge(rec)
+            self.gcs.set_actor_state(spec.actor_id, ACTOR_DEAD,
+                                     reason="creation task failed")
+            w = self._workers.get(rec.worker_id)
+            if w is not None:
+                w.state = "IDLE"
+                w.actor_id = None
+                w.task = None
+                self._idle.append(w.worker_id)
+            return
+        # actor keeps its resource charge for its lifetime
+        if st is not None:
+            st["state"] = ACTOR_ALIVE
+            st["worker_id"] = rec.worker_id
+            st["charge"] = rec.charge
+            st["pg_key"] = rec.pg_key
+        w = self._workers.get(rec.worker_id)
+        if w is not None:
+            w.task = None
+        self.gcs.set_actor_state(spec.actor_id, ACTOR_ALIVE,
+                                 node_id=self.node_id)
+        self._flush_actor_queue(spec.actor_id)
+
+    def _submit_actor_task(self, spec: P.TaskSpec) -> None:
+        self._owned[spec.task_id] = _OwnedTask(
+            spec=spec, kind="actor_call", retries_left=spec.max_retries)
+        rec = self.gcs.actors.get(spec.actor_id)
+        if rec is None or rec.state == ACTOR_DEAD:
+            self._fail_returns(spec, exceptions.ActorDiedError(
+                spec.actor_id, rec.death_reason if rec else "unknown actor"))
+            return
+        owned = self._owned[spec.task_id]
+        owned.assigned_node = rec.node_id
+        if rec.node_id == self.node_id or rec.node_id is None:
+            self._local_actor_task(spec)
+        else:
+            svc = self._service_of(rec.node_id)
+            if svc is None:
+                self._fail_returns(spec, exceptions.ActorDiedError(
+                    spec.actor_id, "actor node is dead"))
+                return
+            svc.post_remote(("remote_actor_task", spec))
+
+    def _local_actor_task(self, spec: P.TaskSpec) -> None:
+        st = self._actors.get(spec.actor_id)
+        if st is None or st["state"] == ACTOR_DEAD:
+            reason = st and "actor is dead" or "unknown actor"
+            self._fail_returns(spec, exceptions.ActorDiedError(
+                spec.actor_id, reason))
+            return
+        self._actor_queues[spec.actor_id].append(spec)
+        if st["state"] == ACTOR_ALIVE:
+            self._flush_actor_queue(spec.actor_id)
+
+    def _flush_actor_queue(self, actor_id: ActorID) -> None:
+        st = self._actors.get(actor_id)
+        q = self._actor_queues.get(actor_id)
+        if st is None or q is None or st["state"] != ACTOR_ALIVE:
+            return
+        w = self._workers.get(st["worker_id"])
+        if w is None or w.conn is None:
+            return
+        while q:
+            spec = q.popleft()
+            rec = _TaskRecord(spec=spec, kind="actor_call", worker_id=w.worker_id)
+            # resolve deps inline; actor calls with unresolved deps wait
+            unresolved = False
+            for slot, val in list(spec.args) + list(spec.kwargs.values()):
+                if slot == "r":
+                    meta = self._lookup_object(val)
+                    if meta is None:
+                        unresolved = True
+                        self._add_dep(rec, val)
+                    else:
+                        rec.deps[val] = meta
+            if unresolved:
+                self._waiting_deps[spec.task_id] = rec
+                rec.kind = "actor_call_waiting"
+                continue
+            self._send_actor_call(rec)
+
+    def _send_actor_call(self, rec: _TaskRecord) -> None:
+        st = self._actors.get(rec.spec.actor_id)
+        if st is None or st["state"] == ACTOR_DEAD:
+            self._fail_returns(rec.spec, exceptions.ActorDiedError(
+                rec.spec.actor_id, "actor is dead"))
+            return
+        if st["state"] != ACTOR_ALIVE:
+            self._actor_queues[rec.spec.actor_id].append(rec.spec)
+            return
+        w = self._workers.get(st["worker_id"])
+        if w is None or w.conn is None:
+            self._actor_queues[rec.spec.actor_id].append(rec.spec)
+            return
+        self._running[rec.spec.task_id] = rec
+        self._record_event(rec.spec, "RUNNING")
+        for oid in rec.deps:
+            self.store.pin(oid)
+        try:
+            w.conn.send((P.EXECUTE_TASK, ("actor_call", rec.spec, rec.deps,
+                                          None)))
+        except OSError:
+            self._events.put(("conn_closed", w.conn_key))
+
+    def _kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        rec = self.gcs.actors.get(actor_id)
+        if rec is None:
+            return
+        if rec.node_id == self.node_id or rec.node_id is None:
+            self._local_kill_actor(actor_id, no_restart)
+        else:
+            svc = self._service_of(rec.node_id)
+            if svc is not None:
+                svc.post_remote(("remote_kill_actor", actor_id, no_restart))
+
+    def _local_kill_actor(self, actor_id: ActorID, no_restart: bool,
+                          reason: str = "killed via kill()") -> None:
+        st = self._actors.get(actor_id)
+        if st is None:
+            return
+        st["no_restart"] = st["no_restart"] or no_restart
+        w = self._workers.get(st.get("worker_id"))
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+        else:
+            self._handle_actor_death(actor_id, reason)
+
+    def _handle_actor_death(self, actor_id: ActorID, reason: str) -> None:
+        st = self._actors.get(actor_id)
+        if st is None:
+            return
+        can_restart = (st["restarts_left"] != 0) and not st["no_restart"]
+        # fail tasks currently running on the actor
+        for tid, rec in list(self._running.items()):
+            if rec.spec.actor_id == actor_id:
+                del self._running[tid]
+                for oid in rec.deps:
+                    self.store.unpin(oid)
+                self._fail_returns(rec.spec, exceptions.ActorDiedError(
+                    actor_id, reason))
+        self._release_actor_charge(st)
+        if can_restart:
+            if st["restarts_left"] > 0:
+                st["restarts_left"] -= 1
+            st["state"] = ACTOR_RESTARTING
+            self.gcs.set_actor_state(actor_id, ACTOR_RESTARTING,
+                                     node_id=self.node_id)
+            spec = st["spec"]
+            tspec = self._creation_task_spec(spec)
+            tspec.return_ids = []      # creation ref was consumed first time
+            self._queue_local(tspec, "actor_create", actor_spec=spec)
+        else:
+            st["state"] = ACTOR_DEAD
+            self.gcs.set_actor_state(actor_id, ACTOR_DEAD, reason=reason)
+            # fail everything still queued
+            q = self._actor_queues.get(actor_id)
+            while q:
+                spec = q.popleft()
+                self._fail_returns(spec, exceptions.ActorDiedError(
+                    actor_id, reason))
+
+    def _release_actor_charge(self, st: dict) -> None:
+        """Return a live actor's resource charge to the pool it came from —
+        the node's free set or its placement-group bundle reservation."""
+        charge = st.get("charge")
+        if not charge:
+            return
+        st["charge"] = None
+        with self._res_lock:
+            pg_key = st.get("pg_key")
+            if pg_key is not None:
+                pool = self.pg_reservations.get(pg_key)
+                if pool is not None:
+                    sched.add(pool, charge)
+            else:
+                sched.add(self.resources_available, charge)
+
+    def _on_actor_event(self, payload) -> None:
+        if payload.get("state") == ACTOR_DEAD:
+            self._events.put(("actor_dead", payload["actor_id"],
+                              payload.get("reason", "")))
+
+    def _on_remote_actor_dead(self, actor_id: ActorID, reason: str) -> None:
+        """Owner-side: fail owned in-flight calls to an actor that died on
+        another node (our local running set doesn't cover those)."""
+        for tid, owned in list(self._owned.items()):
+            if (owned.kind == "actor_call" and not owned.done
+                    and owned.spec.actor_id == actor_id
+                    and owned.assigned_node != self.node_id):
+                owned.done = True
+                self._fail_returns(owned.spec,
+                                   exceptions.ActorDiedError(actor_id, reason))
+
+    # --------------------------------------------------------- cancellation
+    def _cancel_task(self, task_id: TaskID, force: bool) -> None:
+        owned = self._owned.get(task_id)
+        if owned is None or owned.done:
+            return
+        target = owned.assigned_node
+        if target == self.node_id or target is None:
+            self._local_cancel(task_id, force)
+        else:
+            svc = self._service_of(target)
+            if svc is not None:
+                svc.post_remote(("remote_cancel", task_id, force))
+
+    def _local_cancel(self, task_id: TaskID, force: bool) -> None:
+        rec = self._waiting_deps.pop(task_id, None)
+        if rec is None:
+            for i, r in enumerate(self._pending):
+                if r.spec.task_id == task_id:
+                    rec = r
+                    r.cancelled = True
+                    break
+        if rec is not None:
+            self._fail_returns(rec.spec, exceptions.TaskCancelledError(task_id))
+            return
+        rec = self._running.get(task_id)
+        if rec is not None and rec.worker_id is not None:
+            w = self._workers.get(rec.worker_id)
+            if w is not None and w.proc is not None:
+                import signal
+                try:
+                    w.proc.send_signal(
+                        signal.SIGKILL if force else signal.SIGINT)
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------- get/wait
+    def _get_objects(self, conn_key: int, req_id: int,
+                     object_ids: List[ObjectID],
+                     timeout: Optional[float]) -> None:
+        waiter = _Waiter(req_id=req_id, conn_key=conn_key,
+                         object_ids=object_ids)
+        for oid in object_ids:
+            if self._lookup_object(oid) is None:
+                waiter.remaining.add(oid)
+        if not waiter.remaining:
+            self._fire_get(waiter)
+            return
+        waiter_id = self._next_waiter
+        self._next_waiter += 1
+        self._get_waiters[waiter_id] = waiter
+        for oid in waiter.remaining:
+            self._obj_waiter_index.setdefault(oid, set()).add(waiter_id)
+        if timeout is not None:
+            waiter.timer = threading.Timer(
+                timeout, lambda: self._events.put(
+                    ("timer", lambda: self._timeout_get(waiter_id))))
+            waiter.timer.daemon = True
+            waiter.timer.start()
+
+    def _maybe_fire_waiter(self, waiter_id: int, waiter: _Waiter) -> None:
+        if waiter_id in self._get_waiters:
+            if not waiter.remaining:
+                del self._get_waiters[waiter_id]
+                if waiter.timer:
+                    waiter.timer.cancel()
+                self._fire_get(waiter)
+        elif waiter_id in self._wait_waiters:
+            ready = len(waiter.object_ids) - len(waiter.remaining)
+            if ready >= waiter.num_returns:
+                del self._wait_waiters[waiter_id]
+                if waiter.timer:
+                    waiter.timer.cancel()
+                self._fire_wait(waiter)
+
+    def _fire_get(self, waiter: _Waiter) -> None:
+        metas = [self._lookup_object(oid) for oid in waiter.object_ids]
+        self._reply(waiter.conn_key, P.GET_REPLY, (waiter.req_id, metas))
+
+    def _drop_waiter_index(self, waiter_id: int, waiter: _Waiter) -> None:
+        for oid in waiter.remaining:
+            ids = self._obj_waiter_index.get(oid)
+            if ids is not None:
+                ids.discard(waiter_id)
+                if not ids:
+                    del self._obj_waiter_index[oid]
+
+    def _timeout_get(self, waiter_id: int) -> None:
+        waiter = self._get_waiters.pop(waiter_id, None)
+        if waiter is None:
+            return
+        self._drop_waiter_index(waiter_id, waiter)
+        err = to_bytes(exceptions.GetTimeoutError(
+            f"objects not ready within timeout: "
+            f"{[o.hex()[:12] for o in waiter.remaining]}"))
+        self._reply(waiter.conn_key, P.ERROR_REPLY, (waiter.req_id, err))
+
+    def _wait_objects(self, conn_key: int, req_id: int,
+                      object_ids: List[ObjectID], num_returns: int,
+                      timeout: Optional[float]) -> None:
+        waiter = _Waiter(req_id=req_id, conn_key=conn_key,
+                         object_ids=object_ids, num_returns=num_returns)
+        for oid in object_ids:
+            if self._lookup_object(oid) is None:
+                waiter.remaining.add(oid)
+        ready = len(object_ids) - len(waiter.remaining)
+        if ready >= num_returns or timeout == 0:
+            self._fire_wait(waiter)
+            return
+        waiter_id = self._next_waiter
+        self._next_waiter += 1
+        self._wait_waiters[waiter_id] = waiter
+        for oid in waiter.remaining:
+            self._obj_waiter_index.setdefault(oid, set()).add(waiter_id)
+        if timeout is not None:
+            waiter.timer = threading.Timer(
+                timeout, lambda: self._events.put(
+                    ("timer", lambda: self._timeout_wait(waiter_id))))
+            waiter.timer.daemon = True
+            waiter.timer.start()
+
+    def _fire_wait(self, waiter: _Waiter) -> None:
+        ready = [oid for oid in waiter.object_ids
+                 if oid not in waiter.remaining]
+        pending = [oid for oid in waiter.object_ids if oid in waiter.remaining]
+        self._reply(waiter.conn_key, P.WAIT_REPLY,
+                    (waiter.req_id, ready, pending))
+
+    def _timeout_wait(self, waiter_id: int) -> None:
+        waiter = self._wait_waiters.pop(waiter_id, None)
+        if waiter is None:
+            return
+        self._drop_waiter_index(waiter_id, waiter)
+        self._fire_wait(waiter)
+
+    # ------------------------------------------------------- failure paths
+    def _on_conn_closed(self, key: int) -> None:
+        self._conns.pop(key, None)
+        self._driver_conn_keys.discard(key)
+        wid = self._conn_worker.pop(key, None)
+        if wid is None:
+            return
+        w = self._workers.pop(wid, None)
+        if w is None:
+            return
+        if self._stopped.is_set():
+            return
+        w.state = "DEAD"
+        try:
+            self._idle.remove(wid)
+        except ValueError:
+            pass
+        if w.actor_id is not None:
+            st = self._actors.get(w.actor_id)
+            # fail the creation task if it was in flight
+            rec = w.task
+            if rec is not None and rec.kind == "actor_create":
+                self._running.pop(rec.spec.task_id, None)
+                self._release_charge(rec)
+            self._handle_actor_death(w.actor_id, "actor worker process died")
+            return
+        rec = w.task
+        if rec is not None:
+            self._running.pop(rec.spec.task_id, None)
+            for oid in rec.deps:
+                self.store.unpin(oid)
+            self._release_charge(rec)
+            if rec.retries_left > 0:
+                rec.retries_left -= 1
+                rec.worker_id = None
+                rec.charge = None
+                self._pending.append(rec)
+            else:
+                self._fail_returns(rec.spec, exceptions.WorkerCrashedError(
+                    f"worker died while running {rec.spec.name}"))
+        self._dispatch()
+
+    def _on_node_event(self, payload) -> None:
+        if payload.get("state") == "DEAD" and payload["node_id"] != self.node_id:
+            self._events.put(("node_dead", payload["node_id"]))
+
+    def _on_task_finished(self, payload) -> None:
+        self._events.put(("task_finished", payload["task_id"]))
+
+    def _on_node_dead(self, node_id: NodeID) -> None:
+        """Owner-side recovery: resubmit or fail tasks we forwarded to a node
+        that died (reference: lease failure + ``RetryTaskIfPossible``)."""
+        for tid, owned in list(self._owned.items()):
+            if owned.done or owned.assigned_node != node_id:
+                continue
+            if owned.kind == "task":
+                if owned.retries_left > 0:
+                    owned.retries_left -= 1
+                    self._route_task(owned.spec)
+                else:
+                    self._fail_returns(owned.spec,
+                                       exceptions.WorkerCrashedError(
+                                           f"node {node_id} died"))
+                    owned.done = True
+            elif owned.kind == "actor_call":
+                self._fail_returns(owned.spec, exceptions.ActorDiedError(
+                    owned.spec.actor_id, f"node {node_id} died"))
+                owned.done = True
+
+    # -------------------------------------------------------------- pg/info
+    def _create_pg(self, conn_key: int, payload) -> None:
+        req_id, spec = payload
+        assignment = sched.pack_bundles(spec.bundles, spec.strategy,
+                                        self._candidates())
+        if assignment is None:
+            self._reply(conn_key, P.INFO_REPLY, (req_id, None))
+            return
+        ok = True
+        reserved = []
+        for idx, (bundle, nid) in enumerate(zip(spec.bundles, assignment)):
+            svc = self._service_of(nid)
+            if svc is None or not svc.reserve_bundle((spec.pg_id, idx), bundle):
+                ok = False
+                break
+            reserved.append((svc, (spec.pg_id, idx)))
+        if not ok:
+            for svc, key in reserved:
+                svc.release_bundle(key)
+            self._reply(conn_key, P.INFO_REPLY, (req_id, None))
+            return
+        self.gcs.register_pg(spec, assignment)
+        self._reply(conn_key, P.INFO_REPLY, (req_id, assignment))
+
+    def _remove_pg(self, pg_id) -> None:
+        rec = self.gcs.remove_pg(pg_id)
+        if rec is None:
+            return
+        for idx, nid in enumerate(rec["assignment"]):
+            svc = self._service_of(nid)
+            if svc is not None:
+                svc.release_bundle((pg_id, idx))
+
+    def _cluster_info(self, what: str) -> Any:
+        if what == "resources_total":
+            return self.gcs.cluster_resources()
+        if what == "resources_available":
+            out: Dict[str, float] = {}
+            for info in self.gcs.alive_nodes():
+                if info.service is not None:
+                    for k, v in info.service.available_snapshot().items():
+                        out[k] = out.get(k, 0.0) + v
+            return out
+        if what == "nodes":
+            return [{"node_id": n.node_id, "address": n.address,
+                     "resources": n.resources_total, "alive": n.alive,
+                     "labels": n.labels}
+                    for n in self.gcs.nodes.values()]
+        if what == "store_stats":
+            return self.store.stats()
+        if what == "config":
+            return CONFIG.dump()
+        return None
+
+    def _state_query(self, what: str, filters) -> Any:
+        if what == "tasks":
+            return [ev.__dict__ for ev in self.gcs.list_task_events()]
+        if what == "actors":
+            return [{"actor_id": aid, "state": rec.state,
+                     "name": rec.spec.registered_name,
+                     "class_name": rec.spec.name,
+                     "node_id": rec.node_id,
+                     "num_restarts": rec.num_restarts}
+                    for aid, rec in self.gcs.actors.items()]
+        if what == "objects":
+            return [{"object_id": oid, "node_id": nid, "size": meta.size}
+                    for oid, (nid, meta) in self.gcs.directory.items()]
+        if what == "placement_groups":
+            return [{"pg_id": pid, "state": rec["state"],
+                     "bundles": rec["spec"].bundles,
+                     "strategy": rec["spec"].strategy}
+                    for pid, rec in self.gcs.placement_groups.items()]
+        return None
+
+    def _record_event(self, spec: P.TaskSpec, state: str) -> None:
+        self.gcs.record_task_event(TaskEvent(
+            task_id=spec.task_id, name=spec.name, state=state,
+            node_id=self.node_id, timestamp=time.time(),
+            is_actor_task=spec.actor_id is not None))
+
+
+class ActorTaskIds:
+    """Deterministic creation-task id per actor."""
+
+    @staticmethod
+    def creation_task(spec: P.ActorSpec) -> TaskID:
+        return TaskID(TaskID.KIND + spec.actor_id.binary()[1:])

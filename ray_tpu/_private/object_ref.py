@@ -1,0 +1,54 @@
+"""ObjectRef: a future handle to an object in the distributed store.
+
+Reference analogue: ``ObjectRef`` (``python/ray/includes/object_ref.pxi``).
+Dumb by design — it holds only the id; resolution goes through the
+process-global client so refs can be pickled into task args, stored inside
+other objects, and reconstructed in any process of the cluster.
+"""
+
+from __future__ import annotations
+
+from .ids import ObjectID, TaskID
+
+
+class ObjectRef:
+    __slots__ = ("id",)
+
+    def __init__(self, object_id: ObjectID):
+        self.id = object_id
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self) -> TaskID:
+        """The task whose return this ref is. For ``put`` objects the result
+        is a synthetic id that matches no submitted task (cancel is a no-op,
+        as in the reference)."""
+        return TaskID(TaskID.KIND + self.id.binary()[:15])
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from . import context
+        client = context.require_client()
+        return client.as_future(self)
+
+    def __await__(self):
+        import asyncio
+        from . import context
+        client = context.require_client()
+        return asyncio.wrap_future(client.as_future(self)).__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id,))

@@ -1,0 +1,316 @@
+"""Shared-memory object store (plasma-equivalent).
+
+Equivalent role to the reference's plasma store
+(``src/ray/object_manager/plasma/store.h:55`` — shm segments + allocator +
+LRU eviction + spilling). Design differences, on purpose:
+
+- Objects are immutable, one POSIX shm segment per large object
+  (``multiprocessing.shared_memory``) instead of one dlmalloc arena — the
+  kernel is our allocator; small objects are carried inline in RPC frames
+  (reference analogue: in-memory store, ``memory_store.h:43``).
+- Readers in any process attach by name for zero-copy access (pickle-5
+  out-of-band buffers point straight into the mapping), standing in for
+  plasma's fd-passing (``fling.cc``).
+- When the store exceeds its budget, least-recently-used unpinned primary
+  copies are spilled to disk files and restored on demand (reference
+  analogue: ``local_object_manager.h:110`` + ``external_storage.py:246``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory, resource_tracker
+from typing import Dict, List, Optional
+
+from .config import CONFIG
+from .ids import ObjectID
+
+_SHM_PREFIX = "rtpu"
+
+
+def _segment_name(object_id: ObjectID) -> str:
+    return f"{_SHM_PREFIX}{object_id.hex()[:24]}"
+
+
+def create_segment(object_id: ObjectID, size: int) -> shared_memory.SharedMemory:
+    """Create a named segment from a non-authority process (worker/driver
+    writing a large object directly). Unregistered from the resource tracker
+    because lifetime is owned by the node store that adopts it."""
+    seg = shared_memory.SharedMemory(
+        create=True, size=max(size, 1), name=_segment_name(object_id))
+    try:
+        resource_tracker.unregister(seg._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    return seg
+
+
+class _AttachedSegment(shared_memory.SharedMemory):
+    """Reader-side attachment. Swallows the BufferError raised at interpreter
+    exit when user code still holds zero-copy numpy views into the mapping
+    (the OS reclaims it anyway)."""
+
+    def __del__(self):
+        try:
+            super().__del__()
+        except BufferError:
+            pass
+
+
+def attach_segment(name: str) -> shared_memory.SharedMemory:
+    """Attach to an existing segment. Python 3.12's SharedMemory registers
+    with the resource tracker only on create, so attaching needs no
+    unregister dance; cleanup is owned by the node store."""
+    return _AttachedSegment(name=name)
+
+
+@dataclass
+class ObjectMeta:
+    """Where an object's value lives; travels in RPC messages."""
+
+    object_id: ObjectID
+    size: int
+    inline: Optional[bytes] = None  # wire-format bytes, for small objects
+    shm_name: Optional[str] = None  # segment name, for large objects
+    error: Optional[bytes] = None   # pickled exception, for failed tasks
+    node_hint: Optional[bytes] = None  # NodeID binary of a known location
+
+    def is_error(self) -> bool:
+        return self.error is not None
+
+
+@dataclass
+class _Entry:
+    meta: ObjectMeta
+    segment: Optional[shared_memory.SharedMemory] = None
+    sealed: bool = False
+    pinned: int = 0
+    spilled_path: Optional[str] = None
+    last_used: float = field(default_factory=time.monotonic)
+
+
+class ObjectStore:
+    """Node-local authority over object values.
+
+    Thread-safe; used from the node service event loop and (for driver-side
+    fast-path puts) the driver thread.
+    """
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._capacity = capacity_bytes or CONFIG.object_store_memory_mb * (1 << 20)
+        self._used = 0
+        self._spill_dir = spill_dir or CONFIG.spill_directory or "/tmp/rtpu_spill"
+        self.num_spilled = 0
+        self.num_restored = 0
+
+    # ------------------------------------------------------------------ put
+    def put_inline(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
+        meta = ObjectMeta(object_id=object_id, size=len(data), inline=data)
+        with self._lock:
+            self._ensure_capacity(len(data))
+            self._entries[object_id] = _Entry(meta=meta, sealed=True)
+            self._used += len(data)
+        return meta
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        """Allocate a shm segment; caller fills it then calls seal()."""
+        with self._lock:
+            self._ensure_capacity(size)
+            seg = shared_memory.SharedMemory(
+                create=True, size=max(size, 1), name=_segment_name(object_id))
+            meta = ObjectMeta(object_id=object_id, size=size,
+                              shm_name=seg.name)
+            self._entries[object_id] = _Entry(meta=meta, segment=seg)
+            self._used += size
+            return seg.buf[:size]
+
+    def seal(self, object_id: ObjectID) -> ObjectMeta:
+        with self._lock:
+            entry = self._entries[object_id]
+            entry.sealed = True
+            entry.last_used = time.monotonic()
+            return entry.meta
+
+    def put_error(self, object_id: ObjectID, error: bytes) -> ObjectMeta:
+        meta = ObjectMeta(object_id=object_id, size=len(error), error=error)
+        with self._lock:
+            self._entries[object_id] = _Entry(meta=meta, sealed=True)
+        return meta
+
+    def adopt(self, meta: ObjectMeta) -> None:
+        """Record an object whose segment was created by another process
+        (a worker sealing a large task return). This is the main write path,
+        so the store budget is enforced here."""
+        with self._lock:
+            if meta.object_id in self._entries:
+                return
+            if meta.shm_name or meta.inline:
+                self._ensure_capacity(meta.size)
+            self._entries[meta.object_id] = _Entry(meta=meta, sealed=True)
+            self._used += meta.size if (meta.shm_name or meta.inline) else 0
+
+    # ------------------------------------------------------------------ get
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def get_meta(self, object_id: ObjectID) -> Optional[ObjectMeta]:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
+                return None
+            e.last_used = time.monotonic()
+            self._entries.move_to_end(object_id)
+            if e.spilled_path is not None:
+                self._restore(object_id, e)
+            return e.meta
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None:
+                e.pinned += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None and e.pinned > 0:
+                e.pinned -= 1
+
+    def free(self, object_ids: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                e = self._entries.pop(oid, None)
+                if e is None:
+                    continue
+                self._used -= e.meta.size
+                if e.segment is not None:
+                    try:
+                        e.segment.close()
+                        e.segment.unlink()
+                    except FileNotFoundError:
+                        pass
+                elif e.meta.shm_name:
+                    # segment created by a worker/driver process and adopted
+                    # here by name only — unlink it via a fresh attachment
+                    try:
+                        seg = attach_segment(e.meta.shm_name)
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+                if e.spilled_path:
+                    try:
+                        os.unlink(e.spilled_path)
+                    except OSError:
+                        pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_objects": len(self._entries),
+                "used_bytes": self._used,
+                "capacity_bytes": self._capacity,
+                "num_spilled": self.num_spilled,
+                "num_restored": self.num_restored,
+            }
+
+    # ------------------------------------------------------- spill/restore
+    def _ensure_capacity(self, incoming: int) -> None:
+        threshold = CONFIG.object_spilling_threshold * self._capacity
+        if self._used + incoming <= threshold:
+            return
+        for oid in list(self._entries):
+            if self._used + incoming <= threshold:
+                break
+            e = self._entries[oid]
+            if (e.sealed and e.pinned == 0 and e.meta.shm_name is not None
+                    and e.spilled_path is None):
+                self._spill(oid, e)
+
+    def _spill(self, object_id: ObjectID, e: _Entry) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, _segment_name(object_id))
+        seg = e.segment
+        if seg is None:
+            # adopted segment: created by a worker/driver, attach by name
+            try:
+                seg = attach_segment(e.meta.shm_name)
+            except FileNotFoundError:
+                return
+        with open(path, "wb") as f:
+            f.write(seg.buf[:e.meta.size])
+        e.spilled_path = path
+        seg.close()
+        try:
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+        e.segment = None
+        e.meta.shm_name = None
+        self._used -= e.meta.size
+        self.num_spilled += 1
+
+    def _restore(self, object_id: ObjectID, e: _Entry) -> None:
+        self._ensure_capacity(e.meta.size)
+        seg = shared_memory.SharedMemory(
+            create=True, size=max(e.meta.size, 1), name=_segment_name(object_id))
+        with open(e.spilled_path, "rb") as f:
+            f.readinto(seg.buf[:e.meta.size])
+        os.unlink(e.spilled_path)
+        e.spilled_path = None
+        e.segment = seg
+        e.meta.shm_name = seg.name
+        self._used += e.meta.size
+        self.num_restored += 1
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self.free(list(self._entries))
+
+
+# --------------------------------------------------------------- client side
+
+class ObjectReader:
+    """Per-process cache of attached segments for zero-copy reads."""
+
+    def __init__(self):
+        self._segments: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def load(self, meta: ObjectMeta):
+        from . import serialization
+
+        if meta.is_error():
+            raise serialization.from_bytes(meta.error)
+        if meta.inline is not None:
+            return serialization.from_bytes(meta.inline)
+        with self._lock:
+            seg = self._segments.get(meta.shm_name)
+            if seg is None:
+                seg = attach_segment(meta.shm_name)
+                self._segments[meta.shm_name] = seg
+        return serialization.read_from(seg.buf[:meta.size])
+
+    def release(self, shm_name: str) -> None:
+        with self._lock:
+            seg = self._segments.pop(shm_name, None)
+        if seg is not None:
+            seg.close()
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._segments.values():
+                try:
+                    seg.close()
+                except Exception:
+                    pass
+            self._segments.clear()

@@ -1,0 +1,184 @@
+"""Wire protocol between drivers/workers and the node service.
+
+Equivalent role to the reference's gRPC surface (``protobuf/core_worker.proto``,
+``node_manager.proto``): task push, object status, actor control. We use
+length-prefixed pickled frames over unix-domain sockets — the control plane
+is local to a host; cross-host transfer rides the object plane (shm on one
+host, chunked TCP between hosts in the multi-node deployment).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+_LEN = struct.Struct("<I")
+
+# ----------------------------------------------------------------- opcodes
+# client -> service
+REGISTER = 1            # (kind, worker_id, pid)
+SUBMIT_TASK = 2         # TaskSpec
+CREATE_ACTOR = 3        # ActorSpec
+SUBMIT_ACTOR_TASK = 4   # TaskSpec (actor_id set)
+PUT_OBJECT = 5          # ObjectMeta
+GET_OBJECTS = 6         # (req_id, [ObjectID], timeout_s|None)
+WAIT_OBJECTS = 7        # (req_id, [ObjectID], num_returns, timeout_s)
+FREE_OBJECTS = 8        # [ObjectID]
+KILL_ACTOR = 9          # (ActorID, no_restart)
+CANCEL_TASK = 10        # (TaskID, force)
+GET_NAMED_ACTOR = 11    # (req_id, name, namespace)
+KV_PUT = 12             # (key, value, overwrite)
+KV_GET = 13             # (req_id, key)
+KV_DEL = 14             # key
+KV_KEYS = 15            # (req_id, prefix)
+FETCH_FUNCTION = 16     # (req_id, function_id)
+CLUSTER_INFO = 17       # (req_id, what)
+TASK_DONE = 18          # (task_id, [ObjectMeta], error|None, is_actor_creation)
+CREATE_PG = 19          # PlacementGroupSpec
+REMOVE_PG = 20          # PlacementGroupID
+ACTOR_EXIT = 21         # (actor_id, reason)
+SUBSCRIBE_EVENTS = 22   # (req_id, channel)
+STATE_QUERY = 23        # (req_id, what, filters)
+PROFILE_EVENT = 24      # (kind, payload)
+
+# service -> client
+EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
+GET_REPLY = 41          # (req_id, [ObjectMeta])
+WAIT_REPLY = 42         # (req_id, [ready ObjectID], [pending ObjectID])
+NAMED_ACTOR_REPLY = 43  # (req_id, actor_info | None)
+KV_REPLY = 44           # (req_id, value)
+FUNCTION_REPLY = 45     # (req_id, blob | None)
+INFO_REPLY = 46         # (req_id, payload)
+ACTOR_STATE = 47        # (actor_id, state, reason) pushed to interested clients
+SHUTDOWN = 48           # ()
+EVENT = 49              # (channel, payload)
+ERROR_REPLY = 50        # (req_id, pickled exception)
+
+KIND_DRIVER = 0
+KIND_WORKER = 1
+
+
+# ------------------------------------------------------------------- specs
+
+@dataclass
+class TaskSpec:
+    """Immutable description of a task invocation.
+
+    Reference analogue: ``TaskSpecification``
+    (``src/ray/common/task/task_spec.h:244``).
+    """
+
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    function_id: bytes                       # content hash of the pickled fn
+    # each arg: ("v", wire_bytes) inline value | ("r", ObjectID) reference
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs: Dict[str, Tuple[str, Any]] = field(default_factory=dict)
+    num_returns: int = 1
+    return_ids: List[ObjectID] = field(default_factory=list)
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # actor-related
+    actor_id: Optional[ActorID] = None       # set for actor method calls
+    method_name: str = ""
+    seq_no: int = 0                          # actor call ordering
+    # scheduling
+    scheduling_strategy: Any = None          # None | "SPREAD" | NodeAffinity | PG
+    owner_id: bytes = b""                    # WorkerID binary of the submitter
+
+
+@dataclass
+class ActorSpec:
+    """Actor creation description (reference: actor creation TaskSpec +
+    ``gcs_actor_manager.h:281`` registration payload)."""
+
+    actor_id: ActorID
+    job_id: JobID
+    name: str                                # class name for display
+    registered_name: Optional[str] = None    # named-actor name
+    namespace: str = "default"
+    class_blob: bytes = b""                  # cloudpickled class
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs: Dict[str, Tuple[str, Any]] = field(default_factory=dict)
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    is_async: bool = False
+    lifetime: Optional[str] = None           # None | "detached"
+    scheduling_strategy: Any = None
+    creation_return_id: Optional[ObjectID] = None
+
+
+@dataclass
+class PlacementGroupSpec:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]] = field(default_factory=list)
+    strategy: str = "PACK"                   # PACK|SPREAD|STRICT_PACK|STRICT_SPREAD
+    name: str = ""
+
+
+# --------------------------------------------------------------- connection
+
+class Connection:
+    """Blocking framed-message socket with thread-safe sends."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_buf = bytearray()
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 21)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 21)
+
+    def send(self, msg: Tuple[int, Any]) -> None:
+        data = pickle.dumps(msg, protocol=5)
+        frame = _LEN.pack(len(data)) + data
+        with self._send_lock:
+            self._sock.sendall(frame)
+
+    def recv(self) -> Optional[Tuple[int, Any]]:
+        """Blocking receive of one message; None on clean EOF."""
+        header = self._recv_exact(_LEN.size)
+        if header is None:
+            return None
+        (length,) = _LEN.unpack(header)
+        body = self._recv_exact(length)
+        if body is None:
+            return None
+        return pickle.loads(body)
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = self._recv_buf
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(max(n - len(buf), 1 << 16))
+            except (ConnectionResetError, OSError):
+                return None
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        out = bytes(buf[:n])
+        del buf[:n]
+        return out
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def connect_unix(path: str, timeout: float = 30.0) -> Connection:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect(path)
+    sock.settimeout(None)
+    return Connection(sock)

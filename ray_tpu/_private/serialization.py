@@ -1,0 +1,104 @@
+"""Zero-copy serialization for task args, returns and stored objects.
+
+Equivalent role to the reference's serialization layer
+(``python/ray/_private/serialization.py`` + cloudpickle + plasma buffer
+protocol): we use pickle protocol 5 with out-of-band buffers so that numpy
+arrays (and host-side jax.Array data) round-trip without copies when the
+destination is a shared-memory segment, and cloudpickle (vendored in
+``pickle`` fallback) for closures/lambdas.
+
+Wire format of a serialized object:
+
+    [8B total_len][8B meta_len][meta pickle][buf0][buf1]...
+
+where ``meta`` is ``(payload_pickle_bytes, [buf_len, ...])`` and the
+payload pickle references the buffers out-of-band (PickleBuffer).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+try:
+    import cloudpickle as _function_pickler  # provided by the baked-in deps
+except Exception:  # pragma: no cover - cloudpickle ships with the image
+    import pickle as _function_pickler
+
+_HEADER = struct.Struct("<QQ")
+
+
+def dumps_function(fn) -> bytes:
+    """Pickle a function/class including closures (cloudpickle)."""
+    return _function_pickler.dumps(fn)
+
+
+def loads_function(data: bytes):
+    return pickle.loads(data)
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
+    """Serialize to (meta_bytes, out_of_band_buffers).
+
+    Buffers are memoryviews into the original object's storage — the caller
+    writes them into shm (or the socket) without an intermediate copy.
+    """
+    buffers: List[pickle.PickleBuffer] = []
+    try:
+        payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    except (pickle.PicklingError, AttributeError, TypeError):
+        # Fall back to cloudpickle for closures/locally-defined classes.
+        buffers = []
+        payload = _function_pickler.dumps(obj, protocol=5,
+                                          buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    meta = pickle.dumps((payload, [len(v) for v in views]), protocol=5)
+    return meta, views
+
+
+def serialized_size(meta: bytes, views: List[memoryview]) -> int:
+    return _HEADER.size + len(meta) + sum(len(v) for v in views)
+
+
+def write_to(buf: memoryview, meta: bytes, views: List[memoryview]) -> int:
+    """Write the full wire format into ``buf``; returns bytes written."""
+    total = serialized_size(meta, views)
+    _HEADER.pack_into(buf, 0, total, len(meta))
+    off = _HEADER.size
+    buf[off:off + len(meta)] = meta
+    off += len(meta)
+    for v in views:
+        n = len(v)
+        buf[off:off + n] = v.cast("B") if v.format != "B" or v.ndim != 1 else v
+        off += n
+    return total
+
+
+def to_bytes(obj: Any) -> bytes:
+    """One-shot serialize into a contiguous bytes object."""
+    meta, views = serialize(obj)
+    out = bytearray(serialized_size(meta, views))
+    write_to(memoryview(out), meta, views)
+    return bytes(out)
+
+
+def read_from(buf: memoryview) -> Any:
+    """Deserialize from the wire format. Buffers are zero-copy views into
+    ``buf`` — keep the backing storage alive while the object is in use
+    (numpy arrays returned from shm keep a reference via the memoryview)."""
+    total, meta_len = _HEADER.unpack_from(buf, 0)
+    off = _HEADER.size
+    meta = bytes(buf[off:off + meta_len])
+    off += meta_len
+    payload, buf_lens = pickle.loads(meta)
+    oob = []
+    for n in buf_lens:
+        oob.append(buf[off:off + n])
+        off += n
+    return pickle.loads(payload, buffers=oob)
+
+
+def from_bytes(data: bytes) -> Any:
+    return read_from(memoryview(data))

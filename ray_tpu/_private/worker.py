@@ -1,0 +1,244 @@
+"""Worker process entrypoint: executes tasks and hosts actor instances.
+
+Equivalent role to the reference's ``default_worker.py`` +
+``CoreWorker::RunTaskExecutionLoop`` (``python/ray/_private/workers/
+default_worker.py``, ``_raylet.pyx:3035`` run_task_loop,
+``task_execution_handler`` ``_raylet.pyx:1972``): registers with the node
+service, pulls pushed tasks off its socket, loads functions from the
+control-plane KV (cached by content hash), executes, and seals returns
+either inline or into shared memory. Nested API calls (a task calling
+``remote``/``get``) reuse the same connection through the process-global
+``CoreClient``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import inspect
+import os
+import signal
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from queue import SimpleQueue
+from typing import Any, Dict, List, Optional
+
+from .. import exceptions
+from . import context
+from . import protocol as P
+from .client import CoreClient
+from .config import CONFIG
+from .ids import JobID, NodeID, ObjectID, WorkerID
+from .object_store import ObjectMeta, create_segment
+from . import serialization as ser
+
+
+class WorkerRuntime:
+    def __init__(self, socket_path: str, node_id: NodeID,
+                 worker_id: WorkerID):
+        self.node_id = node_id
+        self.worker_id = worker_id
+        self.conn = P.connect_unix(socket_path)
+        self.client = CoreClient(self.conn, JobID.nil(), worker_id,
+                                 P.KIND_WORKER)
+        context.current_client = self.client
+        context.in_worker = True
+        self._functions: Dict[bytes, Any] = {}
+        self._actor_instance: Any = None
+        self._actor_spec: Optional[P.ActorSpec] = None
+        self._exec_queue: "SimpleQueue" = SimpleQueue()
+        self._exec_thread = threading.Thread(target=self._exec_loop,
+                                             daemon=True)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._current_task_thread: Optional[int] = None
+
+    # ------------------------------------------------------------ main loop
+    def run(self) -> None:
+        signal.signal(signal.SIGINT, self._on_sigint)
+        self.conn.send((P.REGISTER, (P.KIND_WORKER,
+                                     self.worker_id.binary(), os.getpid())))
+        self._exec_thread.start()
+        while True:
+            msg = self.conn.recv()
+            if msg is None:
+                os._exit(0)
+            op, payload = msg
+            if op == P.EXECUTE_TASK:
+                kind, spec, deps, actor_spec = payload
+                if kind == "actor_call" and (
+                        self._pool is not None or self._aio_loop is not None):
+                    self._dispatch_concurrent(spec, deps)
+                else:
+                    self._exec_queue.put((kind, spec, deps, actor_spec))
+            elif op == P.SHUTDOWN:
+                os._exit(0)
+            else:
+                self.client.handle_message(op, payload)
+
+    def _on_sigint(self, signum, frame) -> None:
+        """Cancellation: raise TaskCancelledError inside the task thread
+        (reference analogue: KeyboardInterrupt injection on CancelTask)."""
+        tid = self._current_task_thread
+        if tid is not None:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid),
+                ctypes.py_object(exceptions.TaskCancelledError))
+
+    def _exec_loop(self) -> None:
+        while True:
+            kind, spec, deps, actor_spec = self._exec_queue.get()
+            self._current_task_thread = threading.get_ident()
+            try:
+                self._run_one(kind, spec, deps, actor_spec)
+            finally:
+                self._current_task_thread = None
+
+    def _dispatch_concurrent(self, spec: P.TaskSpec, deps) -> None:
+        if self._aio_loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self._run_async(spec, deps), self._aio_loop)
+        else:
+            self._pool.submit(self._run_one, "actor_call", spec, deps, None)
+
+    # ------------------------------------------------------------ execution
+    def _run_one(self, kind: str, spec: P.TaskSpec, deps,
+                 actor_spec: Optional[P.ActorSpec]) -> None:
+        context.current_task_id = spec.task_id
+        try:
+            if kind == "task":
+                fn = self._get_function(spec.function_id)
+                args, kwargs = self._load_args(spec, deps)
+                result = fn(*args, **kwargs)
+            elif kind == "actor_create":
+                result = self._create_actor(actor_spec, spec, deps)
+            else:  # actor_call
+                args, kwargs = self._load_args(spec, deps)
+                method = getattr(self._actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    # sync actor defining an async method: run it here
+                    result = asyncio.new_event_loop().run_until_complete(result)
+            self._send_done(spec, kind, result, None)
+        except BaseException as e:  # noqa: BLE001
+            self._send_done(spec, kind, None, e)
+        finally:
+            context.current_task_id = None
+
+    async def _run_async(self, spec: P.TaskSpec, deps) -> None:
+        try:
+            args, kwargs = self._load_args(spec, deps)
+            method = getattr(self._actor_instance, spec.method_name)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            self._send_done(spec, "actor_call", result, None)
+        except BaseException as e:  # noqa: BLE001
+            self._send_done(spec, "actor_call", None, e)
+
+    def _create_actor(self, actor_spec: P.ActorSpec, spec: P.TaskSpec,
+                      deps) -> Any:
+        cls = ser.loads_function(actor_spec.class_blob)
+        args, kwargs = self._load_args(spec, deps)
+        self._actor_spec = actor_spec
+        context.current_actor_id = actor_spec.actor_id
+        if actor_spec.is_async:
+            self._aio_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._aio_loop.run_forever,
+                                 daemon=True)
+            t.start()
+        elif actor_spec.max_concurrency > 1:
+            self._pool = ThreadPoolExecutor(
+                max_workers=actor_spec.max_concurrency)
+        self._actor_instance = cls(*args, **kwargs)
+        return None
+
+    def _get_function(self, function_id: bytes):
+        fn = self._functions.get(function_id)
+        if fn is None:
+            blob = self.client.fetch_function(function_id)
+            if blob is None:
+                raise RuntimeError(
+                    f"function {function_id.hex()[:12]} not found in KV")
+            fn = ser.loads_function(blob)
+            self._functions[function_id] = fn
+        return fn
+
+    def _load_args(self, spec: P.TaskSpec, deps: Dict[ObjectID, ObjectMeta]):
+        args = [self._load_one(slot, deps) for slot in spec.args]
+        kwargs = {k: self._load_one(slot, deps)
+                  for k, slot in spec.kwargs.items()}
+        return args, kwargs
+
+    def _load_one(self, slot, deps):
+        tag, val = slot
+        if tag == "v":
+            return ser.from_bytes(val)
+        meta = deps.get(val)
+        if meta is None:
+            # dependency not pre-resolved (nested ref): fetch via client
+            from .object_ref import ObjectRef
+            return self.client.get([ObjectRef(val)])[0]
+        return self.client.reader.load(meta)
+
+    # -------------------------------------------------------------- returns
+    def _send_done(self, spec: P.TaskSpec, kind: str, result: Any,
+                   exc: Optional[BaseException]) -> None:
+        metas: List[ObjectMeta] = []
+        err_bytes: Optional[bytes] = None
+        if exc is not None:
+            if isinstance(exc, (exceptions.TaskCancelledError,
+                                exceptions.RayTpuError)):
+                wrapped: BaseException = exc
+            else:
+                wrapped = exceptions.TaskError(
+                    type(exc).__name__, str(exc),
+                    "".join(traceback.format_exception(
+                        type(exc), exc, exc.__traceback__)),
+                    task_name=spec.name)
+            err_bytes = ser.to_bytes(wrapped)
+            for oid in spec.return_ids:
+                metas.append(ObjectMeta(object_id=oid, size=len(err_bytes),
+                                        error=err_bytes))
+        else:
+            values: List[Any]
+            if spec.num_returns == 1:
+                values = [result]
+            elif spec.num_returns == 0:
+                values = []
+            else:
+                values = list(result)
+                if len(values) != spec.num_returns:
+                    self._send_done(spec, kind, None, ValueError(
+                        f"task {spec.name} declared num_returns="
+                        f"{spec.num_returns} but returned {len(values)}"))
+                    return
+            for oid, value in zip(spec.return_ids, values):
+                metas.append(self._store_return(oid, value))
+        self.conn.send((P.TASK_DONE, (spec.task_id, metas, err_bytes, kind)))
+
+    def _store_return(self, oid: ObjectID, value: Any) -> ObjectMeta:
+        smeta, views = ser.serialize(value)
+        total = ser.serialized_size(smeta, views)
+        if total <= CONFIG.max_inline_object_bytes:
+            out = bytearray(total)
+            ser.write_to(memoryview(out), smeta, views)
+            return ObjectMeta(object_id=oid, size=total, inline=bytes(out))
+        seg = create_segment(oid, total)
+        ser.write_to(seg.buf, smeta, views)
+        name = seg.name
+        seg.close()
+        return ObjectMeta(object_id=oid, size=total, shm_name=name)
+
+
+def main() -> None:
+    socket_path, node_hex, worker_hex = sys.argv[1], sys.argv[2], sys.argv[3]
+    rt = WorkerRuntime(socket_path, NodeID.from_hex(node_hex),
+                       WorkerID.from_hex(worker_hex))
+    rt.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,284 @@
+"""Task and actor API: the ``@remote`` decorator and handles.
+
+Equivalent role to the reference's ``RemoteFunction``
+(``python/ray/remote_function.py:40``), ``ActorClass``/``ActorHandle``
+(``python/ray/actor.py:384/1025``) and the ``ray.remote`` decorator
+(``python/ray/_private/worker.py:3027``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ._private import context
+from ._private import protocol as P
+from ._private import serialization as ser
+from ._private.client import function_id_of
+from ._private.config import CONFIG
+from ._private.ids import ActorID, ObjectID
+from ._private.object_ref import ObjectRef
+
+_DEFAULT_TASK_CPUS = 1.0
+_DEFAULT_ACTOR_CPUS = 1.0
+
+
+def _build_resources(opts: Dict[str, Any], default_cpus: float) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    num_cpus = opts.get("num_cpus")
+    res["CPU"] = float(default_cpus if num_cpus is None else num_cpus)
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):  # accepted for API familiarity; maps to TPU
+        res["TPU"] = float(opts["num_gpus"])
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    for k, v in (opts.get("resources") or {}).items():
+        res[k] = float(v)
+    return {k: v for k, v in res.items() if v}
+
+
+class RemoteFunction:
+    """A function callable via ``.remote()`` (reference:
+    ``remote_function.py:40``; submission path ``_remote`` :257)."""
+
+    def __init__(self, fn, **options):
+        self._fn = fn
+        self._options = options
+        self._name = options.get("name") or getattr(fn, "__qualname__",
+                                                    str(fn))
+        self._blob: Optional[bytes] = None
+        self._function_id: Optional[bytes] = None
+        self._lock = threading.Lock()
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = {**self._options, **options}
+        rf = RemoteFunction(self._fn, **merged)
+        rf._blob = self._blob
+        rf._function_id = self._function_id
+        return rf
+
+    def _ensure_exported(self, client) -> bytes:
+        with self._lock:
+            if self._function_id is None:
+                self._blob = ser.dumps_function(self._fn)
+                self._function_id = function_id_of(self._blob)
+        client.ensure_function(self._function_id, lambda: self._blob)
+        return self._function_id
+
+    def remote(self, *args, **kwargs):
+        client = context.require_client()
+        fid = self._ensure_exported(client)
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        refs = client.submit_task(
+            function_id=fid,
+            name=self._name,
+            args=args, kwargs=kwargs,
+            num_returns=num_returns,
+            resources=_build_resources(opts, _DEFAULT_TASK_CPUS),
+            max_retries=opts.get("max_retries",
+                                 CONFIG.task_max_retries_default),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            retry_exceptions=opts.get("retry_exceptions", False))
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._name} cannot be called directly; "
+            f"use {self._name}.remote(...)")
+
+    def __reduce__(self):
+        # RemoteFunction objects are captured by closures shipped to workers;
+        # pickle as (blob, options) so the lock never crosses the wire
+        with self._lock:
+            if self._function_id is None:
+                self._blob = ser.dumps_function(self._fn)
+                self._function_id = function_id_of(self._blob)
+        return (_rebuild_remote_function, (self._blob, self._options))
+
+
+def _rebuild_remote_function(blob: bytes, options: dict) -> "RemoteFunction":
+    rf = RemoteFunction(ser.loads_function(blob), **options)
+    rf._blob = blob
+    rf._function_id = function_id_of(blob)
+    return rf
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str):
+        self._handle = handle
+        self._method_name = method_name
+
+    def options(self, **opts) -> "ActorMethod":
+        m = ActorMethod(self._handle, self._method_name)
+        m._opts = opts
+        return m
+
+    def remote(self, *args, **kwargs):
+        client = context.require_client()
+        # precedence: .options() > @method defaults on the class
+        opts = {**self._handle._method_opts.get(self._method_name, {}),
+                **getattr(self, "_opts", {})}
+        num_returns = opts.get("num_returns", 1)
+        refs = client.submit_actor_task(
+            actor_id=self._handle._actor_id,
+            method_name=self._method_name,
+            args=args, kwargs=kwargs,
+            num_returns=num_returns,
+            seq_no=self._handle._next_seq(),
+            name=f"{self._handle._class_name}.{self._method_name}")
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+
+def _rebuild_handle(actor_id_bytes: bytes, class_name: str,
+                    method_opts: Optional[dict] = None):
+    return ActorHandle(ActorID(actor_id_bytes), class_name, method_opts)
+
+
+class ActorHandle:
+    """Reference to a live actor; methods via attribute access (reference:
+    ``actor.py:1025``). Picklable: reconstructs against the local client."""
+
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 method_opts: Optional[Dict[str, dict]] = None):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_opts = method_opts or {}
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id.binary(), self._class_name,
+                                  self._method_opts))
+
+
+class ActorClass:
+    """Produced by ``@remote`` on a class (reference: ``actor.py:384``)."""
+
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+        self._blob: Optional[bytes] = None
+        self._lock = threading.Lock()
+
+    def options(self, **options) -> "ActorClass":
+        merged = {**self._options, **options}
+        ac = ActorClass(self._cls, **merged)
+        ac._blob = self._blob
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        client = context.require_client()
+        with self._lock:
+            if self._blob is None:
+                self._blob = ser.dumps_function(self._cls)
+        opts = self._options
+        actor_id = ActorID.from_random()
+        packed, pkw = client.pack_args(args, kwargs)
+        creation_return = ObjectID.for_put(client.worker_id)
+        spec = P.ActorSpec(
+            actor_id=actor_id,
+            job_id=client.job_id,
+            name=self._cls.__name__,
+            registered_name=opts.get("name"),
+            namespace=opts.get("namespace", "default"),
+            class_blob=self._blob,
+            args=packed, kwargs=pkw,
+            resources=_build_resources(opts, _DEFAULT_ACTOR_CPUS),
+            max_restarts=opts.get("max_restarts",
+                                  CONFIG.actor_max_restarts_default),
+            max_concurrency=opts.get("max_concurrency", 1),
+            is_async=self._detect_async(),
+            lifetime=opts.get("lifetime"),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            creation_return_id=creation_return)
+        client.create_actor(spec)
+        handle = ActorHandle(actor_id, self._cls.__name__,
+                             self._method_options())
+        handle._ready_ref = ObjectRef(creation_return)
+        return handle
+
+    def _method_options(self) -> Dict[str, dict]:
+        """Collect ``@method(...)`` defaults declared on the class."""
+        out: Dict[str, dict] = {}
+        for name in dir(self._cls):
+            member = getattr(self._cls, name, None)
+            opts = getattr(member, "_rtpu_method_opts", None)
+            if opts:
+                out[name] = opts
+        return out
+
+    def _detect_async(self) -> bool:
+        import inspect
+        for name, member in inspect.getmembers(self._cls):
+            if not name.startswith("__") and inspect.iscoroutinefunction(member):
+                return True
+        return False
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote(...)")
+
+    def __reduce__(self):
+        with self._lock:
+            if self._blob is None:
+                self._blob = ser.dumps_function(self._cls)
+        return (_rebuild_actor_class, (self._blob, self._options))
+
+
+def _rebuild_actor_class(blob: bytes, options: dict) -> "ActorClass":
+    ac = ActorClass(ser.loads_function(blob), **options)
+    ac._blob = blob
+    return ac
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=..., ...)``."""
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("remote() takes keyword options only")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    return decorator
+
+
+def method(**opts):
+    """Decorator for actor methods carrying default options (reference:
+    ``ray.method``)."""
+
+    def decorator(fn):
+        fn._rtpu_method_opts = opts
+        return fn
+
+    return decorator

@@ -1,0 +1,58 @@
+"""In-process multi-node cluster for tests and tools.
+
+Equivalent role to the reference's ``ray.cluster_utils.Cluster``
+(``python/ray/cluster_utils.py:108``) — the primary
+multi-node-without-a-cluster mechanism (SURVEY §4): each ``add_node``
+starts a full node service (its own scheduler, worker subprocess pool and
+object store) sharing one control plane, so scheduling, placement-group
+packing, object transfer and node-failure paths run for real on one
+machine.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Dict, List, Optional
+
+from ._private.gcs import GlobalControlPlane
+from ._private.node import NodeService
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.gcs = GlobalControlPlane()
+        self.session_dir = tempfile.mkdtemp(prefix="rtpu_cluster_")
+        self.nodes: List[NodeService] = []
+        self.head: Optional[NodeService] = None
+        if initialize_head:
+            self.head = self.add_node(**(head_node_args or {}))
+
+    def add_node(self, num_cpus: int = 4, num_tpus: int = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None) -> NodeService:
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus))
+        if num_tpus:
+            res.setdefault("TPU", float(num_tpus))
+        node = NodeService(self.gcs, self.session_dir, res)
+        node.start(labels=labels)
+        self.nodes.append(node)
+        if self.head is None:
+            self.head = node
+        return node
+
+    def remove_node(self, node: NodeService, allow_graceful: bool = False) -> None:
+        """Kill a node, simulating failure (reference analogue:
+        ``Cluster.remove_node`` and the chaos node-killer,
+        ``_private/test_utils.py:1391``)."""
+        node.kill()
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def shutdown(self) -> None:
+        for node in list(self.nodes):
+            node.stop()
+        self.nodes.clear()
+        import shutil
+        shutil.rmtree(self.session_dir, ignore_errors=True)

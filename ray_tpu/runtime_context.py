@@ -1,0 +1,41 @@
+"""Runtime context introspection (reference:
+``python/ray/runtime_context.py`` — get_runtime_context)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ._private import context
+
+
+@dataclass
+class RuntimeContext:
+    job_id: object
+    worker_id: object
+    task_id: Optional[object]
+    actor_id: Optional[object]
+    in_worker: bool
+
+    def get_job_id(self):
+        return self.job_id
+
+    def get_worker_id(self):
+        return self.worker_id
+
+    def get_task_id(self):
+        return self.task_id
+
+    def get_actor_id(self):
+        return self.actor_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    client = context.require_client()
+    return RuntimeContext(
+        job_id=client.job_id,
+        worker_id=client.worker_id,
+        task_id=context.current_task_id,
+        actor_id=context.current_actor_id,
+        in_worker=context.in_worker,
+    )

@@ -1,0 +1,41 @@
+"""Shared fixtures.
+
+Mirrors the reference's test strategy (SURVEY §4): ``rtpu_init`` boots a
+real single-node runtime per test (reference: ``ray_start_regular``,
+``python/ray/tests/conftest.py:410``); ``rtpu_cluster`` runs a real
+multi-node cluster in one process (reference: ``ray_start_cluster`` :491).
+
+JAX tests run on a virtual 8-device CPU mesh: the env vars below must be
+set before jax is imported anywhere in the process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def rtpu_init():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rtpu_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()

@@ -1,0 +1,173 @@
+"""Actor API tests (reference analogue: ``python/ray/tests/test_actor.py``,
+``test_actor_failures.py``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def read(self):
+        return self.value
+
+
+def test_actor_basic(rtpu_init):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_init_args(rtpu_init):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_ordering(rtpu_init):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_two_actors_isolated(rtpu_init):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get([a.incr.remote(), a.incr.remote(), b.incr.remote()])
+    assert ray_tpu.get(a.read.remote()) == 2
+    assert ray_tpu.get(b.read.remote()) == 1
+
+
+def test_actor_method_error(rtpu_init):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor oops")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="actor oops"):
+        ray_tpu.get(b.boom.remote())
+    # actor survives method exceptions
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_named_actor(rtpu_init):
+    Counter.options(name="global_counter").remote(7)
+    handle = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(handle.read.remote()) == 7
+
+
+def test_named_actor_missing(rtpu_init):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("nope")
+
+
+def test_actor_handle_passed_to_task(rtpu_init):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter, times):
+        return ray_tpu.get([counter.incr.remote() for _ in range(times)])[-1]
+
+    assert ray_tpu.get(bump.remote(c, 3)) == 3
+
+
+def test_kill_actor(rtpu_init):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ray_tpu.exceptions.ActorError):
+        ray_tpu.get(c.incr.remote(), timeout=20)
+
+
+def test_actor_restart(rtpu_init):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            import os
+            return os.getpid()
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    p = Phoenix.options(max_restarts=1).remote()
+    pid1 = ray_tpu.get(p.pid.remote())
+    ray_tpu.kill(p, no_restart=False)
+    # after restart, state resets and pid changes
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(p.pid.remote(), timeout=10)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+    assert ray_tpu.get(p.incr.remote()) == 1
+
+
+def test_async_actor(rtpu_init):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, t, tag):
+            import asyncio
+            await asyncio.sleep(t)
+            return tag
+
+    w = AsyncWorker.remote()
+    # both sleep concurrently: total should be ~max not sum
+    t0 = time.time()
+    refs = [w.work.remote(1.0, "a"), w.work.remote(1.0, "b")]
+    assert sorted(ray_tpu.get(refs)) == ["a", "b"]
+    assert time.time() - t0 < 5.0
+
+
+def test_max_concurrency_threaded_actor(rtpu_init):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleepy:
+        def nap(self, t):
+            import threading
+            time.sleep(t)
+            return threading.get_ident()
+
+    s = Sleepy.remote()
+    ray_tpu.get(s.nap.remote(0))  # wait for actor startup before timing
+    t0 = time.time()
+    ray_tpu.get([s.nap.remote(1.0) for _ in range(4)])
+    assert time.time() - t0 < 3.5
+
+
+def test_duplicate_named_actor_raises(rtpu_init):
+    Counter.options(name="dup").remote()
+    h2 = Counter.options(name="dup").remote()
+    with pytest.raises(ValueError, match="already taken"):
+        ray_tpu.get(h2._ready_ref, timeout=15)
+    # original still reachable
+    assert ray_tpu.get(ray_tpu.get_actor("dup").read.remote()) == 0
+
+
+def test_method_decorator_num_returns(rtpu_init):
+    @ray_tpu.remote
+    class Pair:
+        @ray_tpu.method(num_returns=2)
+        def two(self):
+            return "a", "b"
+
+    p = Pair.remote()
+    r1, r2 = p.two.remote()
+    assert ray_tpu.get([r1, r2]) == ["a", "b"]

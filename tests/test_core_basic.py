@@ -1,0 +1,214 @@
+"""Core task/object API tests (reference analogue:
+``python/ray/tests/test_basic.py``)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+def test_put_get(rtpu_init):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+
+
+def test_put_get_large_numpy(rtpu_init):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(rtpu_init):
+    ref = add.remote(1, 2)
+    assert ray_tpu.get(ref) == 3
+
+
+def test_task_with_ref_args(rtpu_init):
+    a = ray_tpu.put(10)
+    b = add.remote(a, 5)
+    c = add.remote(b, ray_tpu.put(1))
+    assert ray_tpu.get(c) == 16
+
+
+def test_many_tasks(rtpu_init):
+    refs = [add.remote(i, i) for i in range(50)]
+    assert ray_tpu.get(refs) == [2 * i for i in range(50)]
+
+
+def test_task_kwargs(rtpu_init):
+    @ray_tpu.remote
+    def f(a, b=1, c=2):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, c=10)) == 12
+
+
+def test_large_args_and_returns(rtpu_init):
+    arr = np.ones((512, 512), dtype=np.float64)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    out = ray_tpu.get(double.remote(arr))
+    assert out.shape == arr.shape
+    assert out[0, 0] == 2.0
+
+
+def test_multiple_returns(rtpu_init):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_task_error_propagates(rtpu_init):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="kapow"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_through_dependency(rtpu_init):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    # passing a failed ref as an arg: loading the arg raises on the worker
+    # and the dependent task fails too
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(echo.remote(boom.remote()), timeout=20)
+
+
+def test_nested_tasks(rtpu_init):
+    @ray_tpu.remote
+    def outer(n):
+        refs = [add.remote(i, 1) for i in range(n)]
+        return sum(ray_tpu.get(refs))
+
+    assert ray_tpu.get(outer.remote(4)) == 1 + 2 + 3 + 4
+
+
+def test_nested_object_ref_in_value(rtpu_init):
+    inner_ref = ray_tpu.put(7)
+
+    @ray_tpu.remote
+    def deref(box):
+        return ray_tpu.get(box["ref"]) + 1
+
+    assert ray_tpu.get(deref.remote({"ref": inner_ref})) == 8
+
+
+def test_wait(rtpu_init):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=10)
+    assert ready == [f]
+    assert pending == [s]
+
+
+def test_wait_timeout(rtpu_init):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    ref = slow.remote()
+    t0 = time.time()
+    ready, pending = ray_tpu.wait([ref], num_returns=1, timeout=0.5)
+    assert time.time() - t0 < 5
+    assert ready == []
+    assert pending == [ref]
+
+
+def test_get_timeout(rtpu_init):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_cluster_resources(rtpu_init):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+    assert len(ray_tpu.nodes()) == 1
+
+
+def test_runtime_context_in_task(rtpu_init):
+    @ray_tpu.remote
+    def whoami():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.in_worker, ctx.get_task_id() is not None
+
+    assert ray_tpu.get(whoami.remote()) == (True, True)
+
+
+def test_num_cpus_zero_tasks(rtpu_init):
+    @ray_tpu.remote(num_cpus=0)
+    def cheap():
+        return 1
+
+    assert ray_tpu.get([cheap.remote() for _ in range(10)]) == [1] * 10
+
+
+def test_cancel_pending_task(rtpu_init):
+    @ray_tpu.remote
+    def hog():
+        time.sleep(60)
+
+    @ray_tpu.remote
+    def queued():
+        return "ran"
+
+    hogs = [hog.remote() for _ in range(4)]  # fill all 4 CPUs
+    victim = queued.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(victim, timeout=15)
+    for h in hogs:
+        ray_tpu.cancel(h, force=True)
+
+
+def test_object_spilling(rtpu_init):
+    import numpy as np
+    # shrink the store so puts force spilling
+    from ray_tpu._private.config import CONFIG
+    import ray_tpu._private.context as ctx
+    import ray_tpu as rt
+    node = rt._global_node
+    node.store._capacity = 4 * (1 << 20)  # 4MB budget
+    refs = [rt.put(np.full(512 * 1024, i, dtype=np.uint8))
+            for i in range(16)]  # 8MB total
+    stats = node.store.stats()
+    assert stats["num_spilled"] > 0
+    # spilled objects restore transparently
+    for i, r in enumerate(refs):
+        arr = rt.get(r)
+        assert arr[0] == i and len(arr) == 512 * 1024
