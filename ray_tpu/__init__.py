@@ -184,9 +184,12 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     _ctx.require_client().cancel_task(ref.task_id(), force)
 
 
-def get_actor(name: str, namespace: str = "default") -> ActorHandle:
-    """Look up a named actor (reference: ``worker.py:2784``)."""
-    info = _ctx.require_client().get_named_actor(name, namespace)
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor (reference: ``worker.py:2784``). Defaults to
+    the namespace passed to ``init()``."""
+    client = _ctx.require_client()
+    namespace = namespace or _ctx.active_namespace()
+    info = client.get_named_actor(name, namespace)
     if info is None:
         raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
     return ActorHandle(info["actor_id"], info["name"])

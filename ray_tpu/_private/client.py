@@ -31,6 +31,7 @@ class CoreClient:
         self.job_id = job_id
         self.worker_id = worker_id
         self.kind = kind
+        self.namespace = "default"  # set by init(namespace=...)
         self.reader = ObjectReader()
         self._futures: Dict[int, Future] = {}
         self._req_lock = threading.Lock()
@@ -38,6 +39,14 @@ class CoreClient:
         self._registered_fns: set = set()
         self._reader_thread: Optional[threading.Thread] = None
         self._closed = threading.Event()
+
+    def _active_namespace(self) -> str:
+        """Task-context namespace if set (worker executing a task), else
+        this client's (driver) namespace — so nested submissions keep
+        propagating the driver's namespace at any depth."""
+        from . import context
+        ns = context.current_namespace.get()
+        return ns if ns is not None else self.namespace
 
     # ------------------------------------------------------------ lifecycle
     def start_reader(self) -> None:
@@ -57,8 +66,13 @@ class CoreClient:
             self.handle_message(*msg)
 
     def handle_message(self, op: int, payload: Any) -> None:
-        if op in (P.GET_REPLY, P.KV_REPLY, P.NAMED_ACTOR_REPLY,
-                  P.FUNCTION_REPLY, P.INFO_REPLY):
+        if op == P.PUT_REPLY:
+            (req_id,) = payload
+            fut = self._futures.pop(req_id, None)
+            if fut is not None:
+                fut.set_result(None)
+        elif op in (P.GET_REPLY, P.KV_REPLY, P.NAMED_ACTOR_REPLY,
+                    P.FUNCTION_REPLY, P.INFO_REPLY):
             req_id, value = payload
             fut = self._futures.pop(req_id, None)
             if fut is not None:
@@ -77,11 +91,16 @@ class CoreClient:
             self._fail_all(ConnectionError("node shutting down"))
 
     def _fail_all(self, exc: Exception) -> None:
-        self._closed.set()
-        for fut in list(self._futures.values()):
+        # _req_lock orders this against _request: a request registered
+        # before the lock is failed here; one after it sees _closed set
+        # and raises instead of registering an unresolvable future.
+        with self._req_lock:
+            self._closed.set()
+            futures = list(self._futures.values())
+            self._futures.clear()
+        for fut in futures:
             if not fut.done():
                 fut.set_exception(exc)
-        self._futures.clear()
 
     def close(self) -> None:
         self._closed.set()
@@ -90,11 +109,13 @@ class CoreClient:
 
     # ------------------------------------------------------------- plumbing
     def _request(self, op: int, make_payload) -> Future:
+        fut: Future = Future()
         with self._req_lock:
+            if self._closed.is_set():
+                raise ConnectionError("connection to node is closed")
             req_id = self._next_req
             self._next_req += 1
-        fut: Future = Future()
-        self._futures[req_id] = fut
+            self._futures[req_id] = fut
         self.conn.send((op, make_payload(req_id)))
         return fut
 
@@ -105,8 +126,31 @@ class CoreClient:
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
         meta = self._store_value(oid, value)
-        self._send(P.PUT_OBJECT, meta)
+        if meta.shm_name is not None:
+            # Large object: block until the node store adopts it, so the
+            # store's budget accounting (and spilling) stays ahead of the
+            # writer — matches the reference, where ``ray.put`` returns only
+            # after the plasma seal (``core_worker.cc:1141``).
+            self._sync_put(meta)
+        else:
+            self._send(P.PUT_OBJECT, meta)
         return ObjectRef(oid)
+
+    def _sync_put(self, meta: ObjectMeta) -> None:
+        """Acked put of a shm-backed object; unlinks the segment if the
+        node rejects it, since no store owns it then."""
+        try:
+            self._request(P.PUT_OBJECT_SYNC,
+                          lambda rid: (rid, meta)).result()
+        except BaseException:
+            from multiprocessing import shared_memory
+            try:
+                seg = shared_memory.SharedMemory(name=meta.shm_name)
+                seg.close()
+                seg.unlink()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
 
     def _store_value(self, oid: ObjectID, value: Any) -> ObjectMeta:
         """Serialize a value; small inline, large into a fresh shm segment."""
@@ -128,7 +172,27 @@ class CoreClient:
         fut = self._request(P.GET_OBJECTS,
                             lambda rid: (rid, ids, timeout))
         metas = fut.result()
-        return [self.reader.load(m) for m in metas]
+        out = []
+        for ref, m in zip(refs, metas):
+            out.append(self._load_meta(ref, m, timeout))
+        return out
+
+    def _load_meta(self, ref: ObjectRef, meta: ObjectMeta,
+                   timeout: Optional[float] = None) -> Any:
+        # The owner may spill (and unlink) the segment between the meta
+        # reply and our attach; a fresh GET restores it at the owning
+        # store, so retry a couple of times before giving up. The retry
+        # keeps the caller's timeout so get(timeout=...) stays bounded.
+        for attempt in range(3):
+            try:
+                return self.reader.load(meta)
+            except FileNotFoundError:
+                if attempt == 2:
+                    raise
+                self.reader.release(meta.shm_name)
+                meta = self._request(
+                    P.GET_OBJECTS,
+                    lambda rid: (rid, [ref.id], timeout)).result()[0]
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
@@ -147,16 +211,30 @@ class CoreClient:
     def as_future(self, ref: ObjectRef) -> Future:
         out: Future = Future()
 
-        def _resolve(fut: Future):
-            try:
-                metas = fut.result()
-                out.set_result(self.reader.load(metas[0]))
-            except BaseException as e:  # noqa: BLE001
-                out.set_exception(e)
+        def _attempt(attempts_left: int):
+            def _resolve(fut: Future):
+                try:
+                    meta = fut.result()[0]
+                    out.set_result(self.reader.load(meta))
+                except FileNotFoundError:
+                    # Segment spilled between reply and attach. This
+                    # callback runs on the reply-routing thread, so retry
+                    # asynchronously (a blocking re-request here would
+                    # deadlock the thread that must process its reply).
+                    if attempts_left > 0:
+                        _attempt(attempts_left - 1)
+                    else:
+                        out.set_exception(
+                            FileNotFoundError(f"object {ref.id} segment "
+                                              "disappeared repeatedly"))
+                except BaseException as e:  # noqa: BLE001
+                    out.set_exception(e)
 
-        inner = self._request(P.GET_OBJECTS,
-                              lambda rid: (rid, [ref.id], None))
-        inner.add_done_callback(_resolve)
+            inner = self._request(P.GET_OBJECTS,
+                                  lambda rid: (rid, [ref.id], None))
+            inner.add_done_callback(_resolve)
+
+        _attempt(2)
         return out
 
     # ---------------------------------------------------------------- args
@@ -174,14 +252,16 @@ class CoreClient:
             out = bytearray(total)
             ser.write_to(memoryview(out), smeta, views)
             return ("v", bytes(out))
-        # large argument: implicit put, pass by reference
+        # Large argument: implicit put, pass by reference. Synchronous for
+        # the same reason as put(): the store's budget accounting must not
+        # lag behind a writer looping over f.remote(big_array).
         oid = ObjectID.for_put(self.worker_id)
         seg = create_segment(oid, total)
         ser.write_to(seg.buf, smeta, views)
         name = seg.name
         seg.close()
-        self._send(P.PUT_OBJECT, ObjectMeta(object_id=oid, size=total,
-                                            shm_name=name))
+        meta = ObjectMeta(object_id=oid, size=total, shm_name=name)
+        self._sync_put(meta)
         return ("r", oid)
 
     # ---------------------------------------------------------------- tasks
@@ -206,7 +286,8 @@ class CoreClient:
             resources=resources, max_retries=max_retries,
             retry_exceptions=retry_exceptions,
             scheduling_strategy=scheduling_strategy,
-            owner_id=self.worker_id.binary())
+            owner_id=self.worker_id.binary(),
+            namespace=self._active_namespace())
         self._send(P.SUBMIT_TASK, spec)
         return [ObjectRef(oid) for oid in return_ids]
 
@@ -226,7 +307,8 @@ class CoreClient:
             args=packed, kwargs=pkw, num_returns=num_returns,
             return_ids=return_ids, resources={},
             actor_id=actor_id, method_name=method_name, seq_no=seq_no,
-            owner_id=self.worker_id.binary())
+            owner_id=self.worker_id.binary(),
+            namespace=self._active_namespace())
         self._send(P.SUBMIT_ACTOR_TASK, spec)
         return [ObjectRef(oid) for oid in return_ids]
 

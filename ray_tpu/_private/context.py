@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import contextvars
 from typing import Any, Optional
 
 # The connected CoreClient for this process (driver after init(), worker
@@ -12,6 +13,18 @@ current_client: Optional[Any] = None
 current_task_id = None
 current_actor_id = None
 in_worker: bool = False
+
+# Per-task namespace: a ContextVar so concurrent method calls of a
+# threaded/async actor each see their own submitter's namespace.
+current_namespace: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_namespace", default=None)
+
+
+def active_namespace() -> str:
+    ns = current_namespace.get()
+    if ns is not None:
+        return ns
+    return current_client.namespace if current_client else "default"
 
 
 def require_client():

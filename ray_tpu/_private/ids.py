@@ -117,7 +117,10 @@ class ObjectID(BaseID):
 
     @classmethod
     def for_put(cls, owner: WorkerID):
-        return cls(cls.KIND + _random_bytes(_ID_SIZE - 1))
+        """Layout: KIND + 7 owner-entropy bytes + 8 random, so the owning
+        worker is identifiable from the id during debugging/recovery."""
+        return cls(cls.KIND + owner.binary()[1:8]
+                   + _random_bytes(_ID_SIZE - 8))
 
     def task_entropy(self) -> bytes:
         return self._bytes[:15]

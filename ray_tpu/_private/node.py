@@ -61,6 +61,9 @@ class _TaskRecord:
     pg_key: Optional[tuple] = None
     actor_spec: Optional[P.ActorSpec] = None
     cancelled: bool = False
+    # stores actually pinned at dispatch, so unpin hits the same store
+    # even if the object's directory entry changes mid-task
+    pinned_stores: Dict[ObjectID, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -319,6 +322,14 @@ class NodeService:
             self._submit_actor_task(payload)
         elif op == P.PUT_OBJECT:
             self._seal_object(payload)
+        elif op == P.PUT_OBJECT_SYNC:
+            req_id, meta = payload
+            try:
+                self._seal_object(meta)
+            except Exception as e:  # noqa: BLE001 — client put() is blocking
+                self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
+            else:
+                self._reply(key, P.PUT_REPLY, (req_id,))
         elif op == P.GET_OBJECTS:
             self._get_objects(key, *payload)
         elif op == P.WAIT_OBJECTS:
@@ -467,14 +478,57 @@ class NodeService:
             rec.remaining_deps.add(oid)
             self._dep_index.setdefault(oid, set()).add(rec.spec.task_id)
 
+    def _pin_deps(self, rec: "_TaskRecord") -> None:
+        """Pin every dependency at its *owning* store just before dispatch,
+        refreshing the meta so the worker never reads a segment the owner
+        spilled between dep resolution and execution (reference analogue:
+        raylet ``PinObjectIDs``, ``node_manager.proto:388``)."""
+        for oid in list(rec.deps):
+            store = self._owning_store(oid)
+            if store is None:
+                continue
+            fresh = store.pin_and_get(oid)
+            if fresh is not None:
+                rec.deps[oid] = fresh
+                rec.pinned_stores[oid] = store
+
+    def _unpin_deps(self, rec: "_TaskRecord") -> None:
+        # Unpin exactly the stores pinned at dispatch — the directory may
+        # have changed (e.g. free()) while the task ran.
+        for oid, store in rec.pinned_stores.items():
+            store.unpin(oid)
+        rec.pinned_stores = {}
+
+    def _owning_store(self, oid: ObjectID):
+        """The store holding the primary copy: ours, or (via the object
+        directory) the owning node's in an in-process cluster."""
+        if self.store.contains(oid):
+            return self.store
+        loc = self.gcs.lookup_location(oid)
+        if loc is None:
+            return None
+        svc = self._service_of(loc[0])
+        return svc.store if svc is not None else None
+
     def _lookup_object(self, oid: ObjectID) -> Optional[ObjectMeta]:
         meta = self.store.get_meta(oid)
         if meta is not None:
             return meta
         loc = self.gcs.lookup_location(oid)
-        if loc is not None:
-            return loc[1]
-        return None
+        if loc is None:
+            return None
+        nid, meta = loc
+        if (meta.shm_name is None and meta.inline is None
+                and meta.error is None):
+            # The owning node spilled it (spilling blanks shm_name on the
+            # directory-shared meta); restore through that node's store —
+            # reference analogue: RestoreSpilledObjects via the primary
+            # raylet (``local_object_manager.h:110``).
+            store = self._owning_store(oid)
+            if store is not None and store is not self.store:
+                return store.get_meta(oid)
+            return None
+        return meta
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(self) -> None:
@@ -595,8 +649,7 @@ class NodeService:
                 st["worker_id"] = wid
         self._running[rec.spec.task_id] = rec
         self._record_event(rec.spec, "RUNNING")
-        for oid in rec.deps:
-            self.store.pin(oid)     # keep dep segments mapped while running
+        self._pin_deps(rec)
         try:
             w.conn.send((P.EXECUTE_TASK, (rec.kind, rec.spec, rec.deps,
                                           rec.actor_spec)))
@@ -608,8 +661,7 @@ class NodeService:
                    error: Optional[bytes], kind: str) -> None:
         rec = self._running.pop(task_id, None)
         if rec is not None:
-            for oid in rec.deps:
-                self.store.unpin(oid)
+            self._unpin_deps(rec)
         for meta in metas:
             self._seal_object(meta)
         if rec is None:
@@ -620,6 +672,7 @@ class NodeService:
         w = self._workers.get(rec.worker_id) if rec.worker_id else None
         if rec.kind == "actor_create":
             self._actor_creation_done(rec, error)
+            self._dispatch()
             return
         self._release_charge(rec)
         if w is not None and w.state == "BUSY":
@@ -835,8 +888,7 @@ class NodeService:
             return
         self._running[rec.spec.task_id] = rec
         self._record_event(rec.spec, "RUNNING")
-        for oid in rec.deps:
-            self.store.pin(oid)
+        self._pin_deps(rec)
         try:
             w.conn.send((P.EXECUTE_TASK, ("actor_call", rec.spec, rec.deps,
                                           None)))
@@ -878,8 +930,7 @@ class NodeService:
         for tid, rec in list(self._running.items()):
             if rec.spec.actor_id == actor_id:
                 del self._running[tid]
-                for oid in rec.deps:
-                    self.store.unpin(oid)
+                self._unpin_deps(rec)
                 self._fail_returns(rec.spec, exceptions.ActorDiedError(
                     actor_id, reason))
         self._release_actor_charge(st)
@@ -891,16 +942,31 @@ class NodeService:
                                      node_id=self.node_id)
             spec = st["spec"]
             tspec = self._creation_task_spec(spec)
-            tspec.return_ids = []      # creation ref was consumed first time
+            # The creation ref is single-use: keep it only if the first
+            # creation never sealed it (worker died mid-__init__), so a
+            # waiter on the ready-ref unblocks when the restart completes.
+            if (spec.creation_return_id
+                    and self._lookup_object(spec.creation_return_id)
+                    is not None):
+                tspec.return_ids = []
             self._queue_local(tspec, "actor_create", actor_spec=spec)
         else:
             st["state"] = ACTOR_DEAD
             self.gcs.set_actor_state(actor_id, ACTOR_DEAD, reason=reason)
+            # Seal the creation ref with the death error if it was never
+            # sealed — otherwise a driver waiting on the ready-ref hangs
+            # forever. (A ref already sealed by a successful __init__ must
+            # not be overwritten in the directory.)
+            spec = st["spec"]
+            if (spec.creation_return_id
+                    and self._lookup_object(spec.creation_return_id) is None):
+                self._fail_returns(self._creation_task_spec(spec),
+                                   exceptions.ActorDiedError(actor_id, reason))
             # fail everything still queued
             q = self._actor_queues.get(actor_id)
             while q:
-                spec = q.popleft()
-                self._fail_returns(spec, exceptions.ActorDiedError(
+                qspec = q.popleft()
+                self._fail_returns(qspec, exceptions.ActorDiedError(
                     actor_id, reason))
 
     def _release_actor_charge(self, st: dict) -> None:
@@ -1092,14 +1158,14 @@ class NodeService:
             rec = w.task
             if rec is not None and rec.kind == "actor_create":
                 self._running.pop(rec.spec.task_id, None)
+                self._unpin_deps(rec)
                 self._release_charge(rec)
             self._handle_actor_death(w.actor_id, "actor worker process died")
             return
         rec = w.task
         if rec is not None:
             self._running.pop(rec.spec.task_id, None)
-            for oid in rec.deps:
-                self.store.unpin(oid)
+            self._unpin_deps(rec)
             self._release_charge(rec)
             if rec.retries_left > 0:
                 rec.retries_left -= 1

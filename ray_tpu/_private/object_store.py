@@ -33,7 +33,10 @@ _SHM_PREFIX = "rtpu"
 
 
 def _segment_name(object_id: ObjectID) -> str:
-    return f"{_SHM_PREFIX}{object_id.hex()[:24]}"
+    # Full 32-hex-char id: put ids carry only 8 random bytes (the rest is
+    # owner entropy), so truncating here would leave too little entropy
+    # and collide segment names at scale.
+    return f"{_SHM_PREFIX}{object_id.hex()}"
 
 
 def create_segment(object_id: ObjectID, size: int) -> shared_memory.SharedMemory:
@@ -91,6 +94,7 @@ class _Entry:
     pinned: int = 0
     spilled_path: Optional[str] = None
     last_used: float = field(default_factory=time.monotonic)
+    charged: bool = False  # whether meta.size is counted in store._used
 
 
 class ObjectStore:
@@ -115,7 +119,8 @@ class ObjectStore:
         meta = ObjectMeta(object_id=object_id, size=len(data), inline=data)
         with self._lock:
             self._ensure_capacity(len(data))
-            self._entries[object_id] = _Entry(meta=meta, sealed=True)
+            self._entries[object_id] = _Entry(meta=meta, sealed=True,
+                                              charged=True)
             self._used += len(data)
         return meta
 
@@ -127,7 +132,8 @@ class ObjectStore:
                 create=True, size=max(size, 1), name=_segment_name(object_id))
             meta = ObjectMeta(object_id=object_id, size=size,
                               shm_name=seg.name)
-            self._entries[object_id] = _Entry(meta=meta, segment=seg)
+            self._entries[object_id] = _Entry(meta=meta, segment=seg,
+                                              charged=True)
             self._used += size
             return seg.buf[:size]
 
@@ -151,10 +157,12 @@ class ObjectStore:
         with self._lock:
             if meta.object_id in self._entries:
                 return
-            if meta.shm_name or meta.inline:
+            charged = bool(meta.shm_name or meta.inline)
+            if charged:
                 self._ensure_capacity(meta.size)
-            self._entries[meta.object_id] = _Entry(meta=meta, sealed=True)
-            self._used += meta.size if (meta.shm_name or meta.inline) else 0
+            self._entries[meta.object_id] = _Entry(meta=meta, sealed=True,
+                                                   charged=charged)
+            self._used += meta.size if charged else 0
 
     # ------------------------------------------------------------------ get
     def contains(self, object_id: ObjectID) -> bool:
@@ -162,22 +170,40 @@ class ObjectStore:
             e = self._entries.get(object_id)
             return e is not None and e.sealed
 
+    def _touch(self, object_id: ObjectID) -> Optional[_Entry]:
+        """Lookup + LRU touch + restore-if-spilled; callers hold _lock."""
+        e = self._entries.get(object_id)
+        if e is None or not e.sealed:
+            return None
+        e.last_used = time.monotonic()
+        self._entries.move_to_end(object_id)
+        if e.spilled_path is not None:
+            self._restore(object_id, e)
+        return e
+
     def get_meta(self, object_id: ObjectID) -> Optional[ObjectMeta]:
         with self._lock:
-            e = self._entries.get(object_id)
-            if e is None or not e.sealed:
-                return None
-            e.last_used = time.monotonic()
-            self._entries.move_to_end(object_id)
-            if e.spilled_path is not None:
-                self._restore(object_id, e)
-            return e.meta
+            e = self._touch(object_id)
+            return e.meta if e is not None else None
 
     def pin(self, object_id: ObjectID) -> None:
         with self._lock:
             e = self._entries.get(object_id)
             if e is not None:
                 e.pinned += 1
+
+    def pin_and_get(self, object_id: ObjectID) -> Optional[ObjectMeta]:
+        """Atomically pin an object and return a live meta, restoring a
+        spilled entry first. This is the dependency-resolution primitive:
+        the pin keeps the segment mapped (spilling skips pinned entries)
+        until the consuming task unpins — reference analogue: raylet
+        ``PinObjectIDs`` before dispatch (``node_manager.proto:388``)."""
+        with self._lock:
+            e = self._touch(object_id)
+            if e is None:
+                return None
+            e.pinned += 1
+            return e.meta
 
     def unpin(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -191,7 +217,8 @@ class ObjectStore:
                 e = self._entries.pop(oid, None)
                 if e is None:
                     continue
-                self._used -= e.meta.size
+                if e.charged:
+                    self._used -= e.meta.size
                 if e.segment is not None:
                     try:
                         e.segment.close()
@@ -257,6 +284,7 @@ class ObjectStore:
         e.segment = None
         e.meta.shm_name = None
         self._used -= e.meta.size
+        e.charged = False
         self.num_spilled += 1
 
     def _restore(self, object_id: ObjectID, e: _Entry) -> None:
@@ -270,6 +298,7 @@ class ObjectStore:
         e.segment = seg
         e.meta.shm_name = seg.name
         self._used += e.meta.size
+        e.charged = True
         self.num_restored += 1
 
     def shutdown(self) -> None:

@@ -46,6 +46,7 @@ ACTOR_EXIT = 21         # (actor_id, reason)
 SUBSCRIBE_EVENTS = 22   # (req_id, channel)
 STATE_QUERY = 23        # (req_id, what, filters)
 PROFILE_EVENT = 24      # (kind, payload)
+PUT_OBJECT_SYNC = 25    # (req_id, ObjectMeta) — acked once the store adopts it
 
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
@@ -59,6 +60,7 @@ ACTOR_STATE = 47        # (actor_id, state, reason) pushed to interested clients
 SHUTDOWN = 48           # ()
 EVENT = 49              # (channel, payload)
 ERROR_REPLY = 50        # (req_id, pickled exception)
+PUT_REPLY = 51          # (req_id,)
 
 KIND_DRIVER = 0
 KIND_WORKER = 1
@@ -93,6 +95,7 @@ class TaskSpec:
     # scheduling
     scheduling_strategy: Any = None          # None | "SPREAD" | NodeAffinity | PG
     owner_id: bytes = b""                    # WorkerID binary of the submitter
+    namespace: str = "default"               # submitter's job namespace
 
 
 @dataclass

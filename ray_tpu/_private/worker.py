@@ -107,6 +107,11 @@ class WorkerRuntime:
     def _run_one(self, kind: str, spec: P.TaskSpec, deps,
                  actor_spec: Optional[P.ActorSpec]) -> None:
         context.current_task_id = spec.task_id
+        # inherit the submitting job's namespace so nested named-actor
+        # lookups/creations resolve where the driver's would (ContextVar:
+        # concurrent calls on a threaded actor don't race each other)
+        context.current_namespace.set(
+            actor_spec.namespace if actor_spec else spec.namespace)
         try:
             if kind == "task":
                 fn = self._get_function(spec.function_id)
@@ -128,6 +133,7 @@ class WorkerRuntime:
             context.current_task_id = None
 
     async def _run_async(self, spec: P.TaskSpec, deps) -> None:
+        context.current_namespace.set(spec.namespace)
         try:
             args, kwargs = self._load_args(spec, deps)
             method = getattr(self._actor_instance, spec.method_name)

@@ -203,7 +203,7 @@ class ActorClass:
             job_id=client.job_id,
             name=self._cls.__name__,
             registered_name=opts.get("name"),
-            namespace=opts.get("namespace", "default"),
+            namespace=opts.get("namespace") or context.active_namespace(),
             class_blob=self._blob,
             args=packed, kwargs=pkw,
             resources=_build_resources(opts, _DEFAULT_ACTOR_CPUS),

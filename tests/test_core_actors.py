@@ -171,3 +171,43 @@ def test_method_decorator_num_returns(rtpu_init):
     p = Pair.remote()
     r1, r2 = p.two.remote()
     assert ray_tpu.get([r1, r2]) == ["a", "b"]
+
+
+def test_actor_crash_in_init_seals_ready_ref(rtpu_init):
+    """A worker that dies mid-__init__ with no restarts must fail the
+    creation ref instead of hanging waiters (regression)."""
+    import os as _os
+
+    @ray_tpu.remote(max_restarts=0)
+    class Bomb:
+        def __init__(self):
+            _os._exit(1)
+
+    h = Bomb.remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(h._ready_ref, timeout=20)
+
+
+def test_actor_crash_in_init_restart_then_ready(rtpu_init):
+    """If the first __init__ attempt dies but restarts remain, the ready
+    ref must resolve after the successful restart (regression: restart
+    path wiped return_ids unconditionally)."""
+    import os as _os
+    import tempfile
+
+    marker = tempfile.mktemp(prefix="rtpu_bomb_")
+
+    @ray_tpu.remote(max_restarts=2)
+    class FlakyInit:
+        def __init__(self):
+            if not _os.path.exists(marker):
+                open(marker, "w").close()
+                _os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    h = FlakyInit.remote()
+    assert ray_tpu.get(h._ready_ref, timeout=30) is None
+    assert ray_tpu.get(h.ping.remote(), timeout=20) == "pong"
+    _os.unlink(marker)

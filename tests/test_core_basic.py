@@ -212,3 +212,33 @@ def test_object_spilling(rtpu_init):
     for i, r in enumerate(refs):
         arr = rt.get(r)
         assert arr[0] == i and len(arr) == 512 * 1024
+
+
+def test_spilled_object_cross_node(rtpu_cluster):
+    """A spilled object must restore when read from another node
+    (regression: spilling blanked the directory-shared meta)."""
+    import numpy as np
+    from ray_tpu._private.scheduler import NodeAffinitySchedulingStrategy
+
+    node_b = rtpu_cluster.add_node(num_cpus=2, resources={"B": 1.0})
+    head = rtpu_cluster.head
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(512 * 1024, dtype=np.uint8)
+
+    pin_head = NodeAffinitySchedulingStrategy(node_id=head.node_id)
+    ref = produce.options(scheduling_strategy=pin_head).remote()
+    ray_tpu.wait([ref], timeout=20)
+    # force the head store to spill it (lock: _ensure_capacity's contract)
+    with head.store._lock:
+        head.store._capacity = 1 << 16
+        head.store._ensure_capacity(1 << 16)
+    assert head.store.stats()["num_spilled"] > 0
+
+    @ray_tpu.remote(resources={"B": 1.0})
+    def consume(a):
+        return int(a.sum())
+
+    got = ray_tpu.get(consume.remote(ref), timeout=30)
+    assert got == int(np.arange(512 * 1024, dtype=np.uint8).sum())
