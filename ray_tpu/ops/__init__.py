@@ -1,0 +1,10 @@
+"""ray_tpu.ops — Pallas TPU kernels and their reference implementations.
+
+The hot ops of the compute path. Each op ships (a) a pure-jnp reference
+implementation (used on CPU and as the ground truth in tests) and (b) a
+Pallas TPU kernel tuned for MXU/VMEM, selected automatically on TPU
+backends.
+"""
+
+from .attention import dot_product_attention, flash_attention  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,29 @@
+"""ray_tpu.parallel — device meshes, logical sharding rules, SPMD helpers.
+
+This is the TPU-native replacement for the reference's process-group world
+(`torch.distributed` rendezvous in ``train/torch/config.py:63`` and the
+NCCL/Gloo groups of ``util/collective/collective.py``): instead of wiring
+N single-device processes together with NCCL, we describe the whole slice
+as one `jax.sharding.Mesh` with named axes (dp/fsdp/tp/sp/pp/ep) and let
+XLA place collectives on ICI.
+"""
+
+from .mesh import (  # noqa: F401
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    MeshSpec,
+    build_mesh,
+    mesh_shape_for,
+)
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    ShardingRules,
+    logical_sharding,
+    logical_spec_to_mesh_spec,
+    shard_params,
+    with_logical_constraint,
+)

@@ -1,0 +1,64 @@
+"""Tests for ray_tpu.parallel: MeshSpec resolution, mesh construction,
+logical sharding rules."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel import (DEFAULT_RULES, MeshSpec, build_mesh,
+                              mesh_shape_for, with_logical_constraint)
+
+
+def test_mesh_spec_resolve_wildcard():
+    spec = MeshSpec(dp=-1, tp=2).resolve(8)
+    assert spec.dp == 4 and spec.tp == 2
+    assert spec.total == 8
+
+
+def test_mesh_spec_rejects_bad_product():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3, tp=2).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+
+
+def test_mesh_spec_total_requires_resolution():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1).total
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(mesh_shape_for(8, tp=2, sp=2))
+    assert mesh.axis_names == ("pp", "dp", "fsdp", "ep", "sp", "tp")
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert sizes["tp"] == 2 and sizes["sp"] == 2 and sizes["dp"] == 2
+
+
+def test_default_rules_produce_valid_specs():
+    # Each activation/weight spec must not repeat a mesh axis.
+    for axes in [("act_batch", "act_seq", "act_embed"),
+                 ("act_batch", "act_seq", "act_heads", "head_dim"),
+                 ("embed", "mlp"), ("embed", "heads", "head_dim"),
+                 ("vocab", "embed")]:
+        spec = DEFAULT_RULES.spec(*axes)
+        flat = [a for e in spec if e is not None
+                for a in (e if isinstance(e, tuple) else (e,))]
+        assert len(flat) == len(set(flat)), (axes, spec)
+
+
+def test_with_logical_constraint_noop_outside_mesh():
+    x = jax.numpy.ones((4, 4))
+    y = with_logical_constraint(x, "act_batch", "act_embed")
+    assert (np.asarray(y) == 1).all()
+
+
+def test_with_logical_constraint_under_mesh():
+    mesh = build_mesh(mesh_shape_for(8, tp=2))
+    with jax.set_mesh(mesh):
+        @jax.jit
+        def f(x):
+            return with_logical_constraint(x * 2, "act_batch", "act_mlp")
+        y = f(jax.numpy.ones((8, 8)))
+    spec = y.sharding.spec
+    assert spec[1] == "tp" or spec[1] == ("tp",)
