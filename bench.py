@@ -1,0 +1,111 @@
+"""Headline benchmark: GPT pretraining throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: training tokens/sec/chip on a ~350M-param GPT (gpt2-medium shape,
+bf16 activations, remat, fused single-program train step). The
+reference's north-star target (BASELINE.json) is >=35% MFU for GPT
+pretraining on TPU; `vs_baseline` is achieved-MFU / 0.35, so 1.0 means
+the north-star bar, higher is better.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOPs per chip by generation.
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 5e11,  # nominal, so CPU smoke runs still produce a number
+}
+
+MFU_TARGET = 0.35  # BASELINE.json north star: >=35% MFU
+
+
+def _chip_gen() -> str:
+    if jax.default_backend() in ("cpu",):
+        return "cpu"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return gen if gen in PEAK_FLOPS else "v5e"
+
+
+def main():
+    from ray_tpu.models import (GPT, gpt2_medium, init_train_state,
+                                make_optimizer, make_train_step)
+    from ray_tpu.models.training import batch_shardings, flops_per_token
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    on_cpu = jax.default_backend() == "cpu"
+    n_dev = len(jax.devices())
+    # batch scales with device count so act_batch stays shardable over dp.
+    if on_cpu:
+        from ray_tpu.models import llama_tiny
+        cfg = llama_tiny()
+        batch, seq, steps, warmup = 2 * n_dev, 128, 4, 2
+    else:
+        cfg = gpt2_medium(max_seq_len=1024)
+        batch, seq, steps, warmup = 16 * n_dev, 1024, 20, 3
+
+    mesh = None
+    model_kwargs = {}
+    if n_dev > 1:
+        mesh = build_mesh(MeshSpec(dp=-1).resolve(n_dev))
+        model_kwargs["mesh"] = mesh
+    model = GPT(cfg, **model_kwargs)
+    opt = make_optimizer(total_steps=steps + warmup)
+    state = init_train_state(model, opt, jax.random.PRNGKey(0), mesh=mesh)
+    step = make_train_step(model, opt, mesh=mesh)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch_dict = {"tokens": tokens}
+    if mesh is not None:
+        batch_dict = {"tokens": jax.device_put(tokens,
+                                               batch_shardings(mesh))}
+
+    # NB: sync via host transfer (float()) — block_until_ready returns
+    # early on the experimental axon PJRT backend.
+    for _ in range(warmup):
+        state, metrics = step(state, batch_dict)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dict)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = steps * tokens_per_step / dt
+    tokens_per_sec_chip = tokens_per_sec / n_dev
+    flops_tok = flops_per_token(cfg)
+    mfu = tokens_per_sec_chip * flops_tok / PEAK_FLOPS[_chip_gen()]
+
+    print(json.dumps({
+        "metric": "gpt_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / MFU_TARGET, 4),
+        "detail": {
+            "model": "gpt2_medium" if not on_cpu else "llama_tiny",
+            "n_params": cfg.n_params,
+            "batch": batch, "seq": seq, "steps": steps,
+            "n_devices": n_dev,
+            "backend": jax.default_backend(),
+            "chip": _chip_gen(),
+            "mfu": round(mfu, 4),
+            "step_time_s": round(dt / steps, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,418 @@
+"""Decoder-only transformer, TPU-first.
+
+One implementation covers the GPT-2 family (learned positions, GELU MLP,
+LayerNorm) and the Llama family (RoPE, SwiGLU, RMSNorm, GQA) through
+`GPTConfig` switches — the reference ships these as external torch models
+driven by Ray Train (`release/train_tests`, SURVEY §6 north-star configs);
+here the model itself is framework-native.
+
+TPU-first choices:
+  * scan-over-layers with stacked params — one compiled block body,
+    compile time O(1) in depth, and GSPMD gathers FSDP-sharded weights
+    one layer at a time (ZeRO-3 semantics for free).
+  * logical-axis names on every param/activation dim; the mesh mapping
+    lives in `ray_tpu.parallel.sharding.ShardingRules`.
+  * attention dispatch: Pallas flash kernel on one sequence shard,
+    ring attention (`ops/ring_attention.py`) over the `sp` mesh axis when
+    the sequence is context-parallel — both wrapped in `shard_map` so the
+    kernel sees local blocks; everything else is GSPMD.
+  * bf16 activations, f32 params/optimizer (cast at use).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ..ops.attention import dot_product_attention
+from ..ops.ring_attention import ring_attention
+from ..parallel.sharding import (DEFAULT_RULES, ShardingRules,
+                                 with_logical_constraint)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # GPT-2 vocab padded to a multiple of 128
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    n_kv_heads: Optional[int] = None  # None -> n_heads (MHA); < n_heads -> GQA
+    d_ff: Optional[int] = None        # None -> 4*d_model (gelu) / 8/3*d (swiglu)
+    max_seq_len: int = 1024
+    # family switches
+    activation: str = "gelu"          # "gelu" | "swiglu"
+    norm: str = "layernorm"           # "layernorm" | "rmsnorm"
+    positions: str = "learned"        # "learned" | "rope"
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    # numerics
+    dtype: Any = jnp.bfloat16         # activation dtype
+    param_dtype: Any = jnp.float32
+    # training
+    remat: bool = True
+    z_loss: float = 1e-4
+    # attention kernel: "auto" | "pallas" | "pallas_interpret" | "reference"
+    attention_impl: str = "auto"
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.activation == "swiglu":
+            # llama convention: 8/3 * d, rounded up to a multiple of 256
+            raw = int(8 * self.d_model / 3)
+            return (raw + 255) // 256 * 256
+        return 4 * self.d_model
+
+    @property
+    def n_params(self) -> int:
+        """Approximate parameter count (excludes norms/bias)."""
+        d, f, v = self.d_model, self.ff_dim, self.vocab_size
+        hd, h, hk = self.head_dim, self.n_heads, self.kv_heads
+        attn = d * h * hd + 2 * d * hk * hd + h * hd * d
+        mlp = (3 if self.activation == "swiglu" else 2) * d * f
+        emb = v * d * (1 if self.tie_embeddings else 2)
+        return self.n_layers * (attn + mlp) + emb
+
+
+# --- presets ---------------------------------------------------------------
+
+def gpt2_small(**kw) -> GPTConfig:
+    return GPTConfig(n_layers=12, d_model=768, n_heads=12, **kw)
+
+
+def gpt2_medium(**kw) -> GPTConfig:
+    return GPTConfig(n_layers=24, d_model=1024, n_heads=16, **kw)
+
+
+def gpt2_large(**kw) -> GPTConfig:
+    return GPTConfig(n_layers=36, d_model=1280, n_heads=20, **kw)
+
+
+def _llama(**kw) -> GPTConfig:
+    base = dict(activation="swiglu", norm="rmsnorm", positions="rope",
+                tie_embeddings=False, vocab_size=32000, max_seq_len=2048)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def llama_tiny(**kw) -> GPTConfig:
+    """Test-scale llama-style config (CPU-friendly)."""
+    return _llama(n_layers=2, d_model=128, n_heads=4, n_kv_heads=2,
+                  vocab_size=512, max_seq_len=256, **kw)
+
+
+def llama_1b(**kw) -> GPTConfig:
+    return _llama(n_layers=16, d_model=2048, n_heads=16, n_kv_heads=8, **kw)
+
+
+def llama_7b(**kw) -> GPTConfig:
+    return _llama(n_layers=32, d_model=4096, n_heads=32, d_ff=11008,
+                  max_seq_len=4096, **kw)
+
+
+# --- init ------------------------------------------------------------------
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+class GPT:
+    """Functional model: `init` → params pytree, `apply` → logits.
+
+    Parallelism is injected at construction: a `Mesh` + `ShardingRules`.
+    With no mesh (unit tests, single device) everything degrades to plain
+    single-device JAX.
+    """
+
+    def __init__(self, config: GPTConfig, mesh: Optional[Mesh] = None,
+                 rules: Optional[ShardingRules] = None):
+        self.config = config
+        self.mesh = mesh
+        self.rules = rules if rules is not None else DEFAULT_RULES
+
+    # -- parameters --------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Params:
+        c = self.config
+        pd = c.param_dtype
+        d, f, hd = c.d_model, c.ff_dim, c.head_dim
+        h, hk, L = c.n_heads, c.kv_heads, c.n_layers
+        std = 0.02
+        resid_std = std / math.sqrt(2 * L)
+        keys = jax.random.split(rng, 10)
+
+        def ones(shape):
+            return jnp.ones(shape, pd)
+
+        blocks = {
+            "norm1": ones((L, d)),
+            "norm2": ones((L, d)),
+            "wq": _normal(keys[0], (L, d, h, hd), std, pd),
+            "wk": _normal(keys[1], (L, d, hk, hd), std, pd),
+            "wv": _normal(keys[2], (L, d, hk, hd), std, pd),
+            "wo": _normal(keys[3], (L, h, hd, d), resid_std, pd),
+            "w_up": _normal(keys[4], (L, d, f), std, pd),
+            "w_down": _normal(keys[5], (L, f, d), resid_std, pd),
+        }
+        if c.activation == "swiglu":
+            blocks["w_gate"] = _normal(keys[6], (L, d, f), std, pd)
+        if c.norm == "layernorm":
+            blocks["bias1"] = jnp.zeros((L, d), pd)
+            blocks["bias2"] = jnp.zeros((L, d), pd)
+        params: Params = {
+            "tok_embed": _normal(keys[7], (c.vocab_size, d), std, pd),
+            "blocks": blocks,
+            "norm_f": ones((d,)),
+        }
+        if c.positions == "learned":
+            params["pos_embed"] = _normal(keys[8], (c.max_seq_len, d), std,
+                                          pd)
+        if c.norm == "layernorm":
+            params["bias_f"] = jnp.zeros((d,), pd)
+        if not c.tie_embeddings:
+            params["lm_head"] = _normal(keys[9], (d, c.vocab_size), std, pd)
+        return params
+
+    def param_logical_axes(self) -> Params:
+        """Pytree matching `init` output: tuples of logical axis names."""
+        c = self.config
+        blocks = {
+            "norm1": ("layers", None),
+            "norm2": ("layers", None),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
+        if c.activation == "swiglu":
+            blocks["w_gate"] = ("layers", "embed", "mlp")
+        if c.norm == "layernorm":
+            blocks["bias1"] = ("layers", None)
+            blocks["bias2"] = ("layers", None)
+        axes: Params = {
+            "tok_embed": ("vocab", "embed"),
+            "blocks": blocks,
+            "norm_f": (None,),
+        }
+        if c.positions == "learned":
+            axes["pos_embed"] = (None, "embed")
+        if c.norm == "layernorm":
+            axes["bias_f"] = (None,)
+        if not c.tie_embeddings:
+            axes["lm_head"] = ("embed", "vocab")
+        return axes
+
+    # -- building blocks ---------------------------------------------------
+
+    def _norm(self, x, scale, bias):
+        c = self.config
+        xf = x.astype(jnp.float32)
+        if c.norm == "rmsnorm":
+            xf = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-6)
+            return (xf * scale.astype(jnp.float32)).astype(c.dtype)
+        mean = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.var(xf, -1, keepdims=True)
+        xf = (xf - mean) * lax.rsqrt(var + 1e-5)
+        out = xf * scale.astype(jnp.float32)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+        return out.astype(c.dtype)
+
+    def _rope(self, x, positions):
+        """x: [B, S, H, D_h]; positions: [B, S]."""
+        c = self.config
+        hd = x.shape[-1]
+        half = hd // 2
+        freqs = c.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32)
+                                 / half)
+        angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+        return out.astype(x.dtype)
+
+    def _sp_size(self) -> int:
+        """Size of the mesh axis act_seq maps to (sequence parallelism)."""
+        if self.mesh is None:
+            return 1
+        ax = self.rules.mesh_axes("act_seq")
+        if isinstance(ax, str) and ax in self.mesh.shape:
+            return self.mesh.shape[ax]
+        return 1
+
+    def _attention(self, q, k, v):
+        """q: [B, S, H, Dh], k/v: [B, S, Hk, Dh] → [B, S, H, Dh].
+
+        Kernels want [B, H, S, Dh]; ring attention additionally wants the
+        sequence axis *locally* sharded, so both pallas paths run under
+        shard_map with specs derived from the mesh.
+        """
+        c = self.config
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        kt = jnp.transpose(k, (0, 2, 1, 3))
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        sp = self._sp_size()
+        if sp > 1:
+            # Specs derive from the rules table like every other sharding
+            # decision; the ring axis is whatever act_seq maps to.
+            spec_q = self.rules.spec("act_batch", "act_heads", "act_seq",
+                                     "head_dim")
+            spec_kv = self.rules.spec("act_batch", "act_kv_heads",
+                                      "act_seq", "head_dim")
+            seq_axis = self.rules.mesh_axes("act_seq")
+            assert isinstance(seq_axis, str), (
+                "ring attention needs act_seq mapped to a single mesh axis")
+
+            def local(qb, kb, vb):
+                return ring_attention(qb, kb, vb, seq_axis, True, None,
+                                      c.attention_impl, c.attn_block_q,
+                                      c.attn_block_k)
+
+            ot = jax.shard_map(local, mesh=self.mesh,
+                               in_specs=(spec_q, spec_kv, spec_kv),
+                               out_specs=spec_q, check_vma=False)(qt, kt, vt)
+        elif self.mesh is not None:
+            spec_q = self.rules.spec("act_batch", "act_heads", None, None)
+            spec_kv = self.rules.spec("act_batch", "act_kv_heads", None,
+                                      None)
+
+            def local(qb, kb, vb):
+                return dot_product_attention(
+                    qb, kb, vb, causal=True, impl=c.attention_impl,
+                    block_q=c.attn_block_q, block_k=c.attn_block_k)
+
+            ot = jax.shard_map(local, mesh=self.mesh,
+                               in_specs=(spec_q, spec_kv, spec_kv),
+                               out_specs=spec_q, check_vma=False)(qt, kt, vt)
+        else:
+            ot = dot_product_attention(qt, kt, vt, causal=True,
+                                       impl=c.attention_impl,
+                                       block_q=c.attn_block_q,
+                                       block_k=c.attn_block_k)
+        return jnp.transpose(ot, (0, 2, 1, 3))
+
+    def _constrain(self, x, *logical):
+        return with_logical_constraint(x, *logical, rules=self.rules,
+                                       mesh=self.mesh)
+
+    def _block(self, x, positions, w):
+        """One transformer block. x: [B, S, D] bf16."""
+        c = self.config
+        dt = c.dtype
+
+        h = self._norm(x, w["norm1"], w.get("bias1"))
+        q = jnp.einsum("bsd,dhk->bshk", h, w["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bshk", h, w["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bshk", h, w["wv"].astype(dt))
+        if c.positions == "rope":
+            q = self._rope(q, positions)
+            k = self._rope(k, positions)
+        q = self._constrain(q, "act_batch", "act_seq", "act_heads",
+                            "head_dim")
+        k = self._constrain(k, "act_batch", "act_seq", "act_kv_heads",
+                            "head_dim")
+        attn = self._attention(q, k, v)
+        attn = jnp.einsum("bshk,hkd->bsd", attn, w["wo"].astype(dt))
+        x = x + self._constrain(attn, "act_batch", "act_seq", "act_embed")
+
+        h = self._norm(x, w["norm2"], w.get("bias2"))
+        up = jnp.einsum("bsd,df->bsf", h, w["w_up"].astype(dt))
+        if c.activation == "swiglu":
+            gate = jnp.einsum("bsd,df->bsf", h, w["w_gate"].astype(dt))
+            act = jax.nn.silu(gate) * up
+        else:
+            act = jax.nn.gelu(up, approximate=True)
+        act = self._constrain(act, "act_batch", "act_seq", "act_mlp")
+        down = jnp.einsum("bsf,fd->bsd", act, w["w_down"].astype(dt))
+        x = x + self._constrain(down, "act_batch", "act_seq", "act_embed")
+        return x
+
+    # -- forward -----------------------------------------------------------
+
+    def apply(self, params: Params, tokens: jax.Array,
+              positions: Optional[jax.Array] = None) -> jax.Array:
+        """tokens: [B, S] int32 → logits [B, S, V] (f32)."""
+        c = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32),
+                tokens.shape)
+        x = params["tok_embed"].astype(c.dtype)[tokens]
+        if c.positions == "learned":
+            x = x + params["pos_embed"].astype(c.dtype)[positions]
+        x = self._constrain(x, "act_batch", "act_seq", "act_embed")
+
+        block_fn = self._block
+        if c.remat:
+            block_fn = jax.checkpoint(
+                block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        def scan_body(x, layer_w):
+            return block_fn(x, positions, layer_w), None
+
+        x, _ = lax.scan(scan_body, x, params["blocks"])
+        x = self._norm(x, params["norm_f"], params.get("bias_f"))
+        if c.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", x,
+                                params["tok_embed"].astype(c.dtype))
+        else:
+            logits = jnp.einsum("bsd,dv->bsv", x,
+                                params["lm_head"].astype(c.dtype))
+        logits = self._constrain(logits, "act_batch", "act_seq", "act_vocab")
+        return logits.astype(jnp.float32)
+
+    def loss(self, params: Params, batch: Dict[str, jax.Array]
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Next-token cross entropy (+ z-loss) with an optional loss mask.
+
+        batch: {"tokens": [B, S] int32, optional "loss_mask": [B, S]}.
+        Targets are tokens shifted left; the final position is masked.
+        """
+        c = self.config
+        tokens = batch["tokens"]
+        logits = self.apply(params, tokens)  # [B, S, V] f32
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+        mask = jnp.concatenate(
+            [jnp.ones_like(tokens[:, 1:], jnp.float32),
+             jnp.zeros_like(tokens[:, :1], jnp.float32)], axis=1)
+        if "loss_mask" in batch:
+            mask = mask * batch["loss_mask"].astype(jnp.float32)
+
+        lse = jax.nn.logsumexp(logits, axis=-1)            # [B, S]
+        true_logit = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1)[..., 0]   # [B, S]
+        nll = lse - true_logit
+        total = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / total
+        if c.z_loss:
+            loss = loss + c.z_loss * (lse ** 2 * mask).sum() / total
+        metrics = {
+            "loss": loss,
+            "ppl_log": (nll * mask).sum() / total,
+            "tokens": mask.sum(),
+        }
+        return loss, metrics
