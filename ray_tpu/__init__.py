@@ -99,6 +99,7 @@ def init(address: Optional[Any] = None,
                              os.getpid())))
     client.start_reader()
     client.namespace = namespace
+    client.node_id = _global_node.node_id
     _ctx.current_client = client
     _global_gcs.register_job(JobRecord(job_id=job_id, driver_pid=os.getpid(),
                                        start_time=time.time()))

@@ -31,6 +31,7 @@ class CoreClient:
         self.job_id = job_id
         self.worker_id = worker_id
         self.kind = kind
+        self.node_id = None         # set by driver init / worker runtime
         self.namespace = "default"  # set by init(namespace=...)
         self.reader = ObjectReader()
         self._futures: Dict[int, Future] = {}

@@ -43,6 +43,7 @@ class WorkerRuntime:
         self.conn = P.connect_unix(socket_path)
         self.client = CoreClient(self.conn, JobID.nil(), worker_id,
                                  P.KIND_WORKER)
+        self.client.node_id = node_id
         context.current_client = self.client
         context.in_worker = True
         self._functions: Dict[bytes, Any] = {}

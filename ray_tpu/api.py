@@ -162,7 +162,10 @@ class ActorHandle:
             return self._seq
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        # Underscore attributes fail lookup (pickle/inspect/duck-typing
+        # probes expect AttributeError) — except the framework's own
+        # actor hooks (_rtpu_*), which are remote-callable.
+        if name.startswith("_") and not name.startswith("_rtpu_"):
             raise AttributeError(name)
         return ActorMethod(self, name)
 

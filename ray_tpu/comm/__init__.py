@@ -1,0 +1,33 @@
+"""ray_tpu.comm — the two communication planes (SURVEY §5, §7.7).
+
+1. **In-program ICI collectives** — the default on TPU: psum/all_gather/
+   ppermute inside jitted SPMD programs over a `jax.sharding.Mesh`
+   (see ``ray_tpu.parallel``). There are no process groups to manage;
+   XLA places the collectives. This replaces the reference's NCCL plane
+   (``util/collective/collective_group/nccl_collective_group.py:127``).
+2. **Host-level collectives** (this package): actor-to-actor
+   allreduce/allgather/… for arrays that live on *hosts* (cross-slice
+   DCN transfers, CPU rollout workers, parameter servers). API mirrors
+   the reference's ``util/collective/collective.py:258-615``; rendezvous
+   runs through a named actor like the reference's named-store rendezvous.
+
+``MeshGroup`` ties a placement-group gang of host actors to one logical
+device mesh — the SPMD-vs-actor bridge (SURVEY §7 "hard parts").
+"""
+
+from .collective import (  # noqa: F401
+    CollectiveActorMixin,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from .device_mesh import MeshGroup, mesh_group  # noqa: F401

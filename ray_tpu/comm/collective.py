@@ -1,0 +1,311 @@
+"""Host-level collective communication over the object plane.
+
+API mirrors the reference's ``util/collective/collective.py:258-615``
+(allreduce/allgather/reducescatter/broadcast/send/recv/barrier, group
+init by world_size+rank+group_name). Where the reference backs these
+with NCCL/Gloo process groups, here membership + rendezvous live in a
+named **coordinator actor** and payloads ride the shared-memory object
+store (zero-copy numpy) — the right transport for host arrays; device
+arrays inside one slice should use in-program XLA collectives instead.
+
+Reductions are computed once in the coordinator (numpy) rather than in a
+ring: host-level groups are small (one member per host), and one
+put+get through shm beats O(ranks) python-loop ring steps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import get, get_actor, put
+from ..api import remote
+
+_GROUP_ACTOR_PREFIX = "rtpu:collective:"
+
+# ops
+SUM = "sum"
+PROD = "prod"
+MIN = "min"
+MAX = "max"
+
+_REDUCERS = {
+    SUM: lambda arrs: np.sum(arrs, axis=0),
+    PROD: lambda arrs: np.prod(arrs, axis=0),
+    MIN: lambda arrs: np.min(arrs, axis=0),
+    MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+@remote(num_cpus=0)
+class _Coordinator:
+    """Rendezvous + reduction point for one collective group.
+
+    Each collective call is identified by (op_kind, seq). Members post
+    contributions; the call completes when world_size contributions have
+    arrived. Sequence numbers are tracked per member so reuse across
+    repeated calls is safe.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._calls: Dict[tuple, dict] = {}
+        self._mailbox: Dict[tuple, Any] = {}
+
+    def _call(self, key):
+        rec = self._calls.get(key)
+        if rec is None:
+            rec = {"parts": {}, "result": None, "done": False}
+            self._calls[key] = rec
+        return rec
+
+    def contribute(self, key, rank: int, value) -> None:
+        rec = self._call(key)
+        rec["parts"][rank] = value
+
+    def poll(self, key, op: Optional[str]):
+        """Returns (done, result). Computes the reduction exactly once."""
+        rec = self._call(key)
+        if rec["done"]:
+            return True, rec["result"]
+        if len(rec["parts"]) < self.world_size:
+            return False, None
+        parts = [rec["parts"][r] for r in range(self.world_size)]
+        if op is None:            # allgather / barrier: list of parts
+            rec["result"] = parts
+        else:
+            rec["result"] = _REDUCERS[op](np.stack(
+                [np.asarray(p) for p in parts]))
+        rec["done"] = True
+        rec["acks"] = set()
+        return True, rec["result"]
+
+    def ack(self, key, rank: int) -> None:
+        rec = self._calls.get(key)
+        if rec is None:
+            return
+        rec.setdefault("acks", set()).add(rank)
+        if len(rec["acks"]) >= self.world_size:
+            del self._calls[key]
+
+    def post(self, dst_rank: int, tag, value) -> None:
+        self._mailbox[(dst_rank, tag)] = value
+
+    def take(self, dst_rank: int, tag):
+        if (dst_rank, tag) in self._mailbox:
+            return True, self._mailbox.pop((dst_rank, tag))
+        return False, None
+
+
+class _GroupState:
+    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self.seq = 0
+        # p2p sequence counters keyed by (peer_rank, tag)
+        self.send_seq: Dict[tuple, int] = {}
+        self.recv_seq: Dict[tuple, int] = {}
+
+
+# Per-process registry (module-global like the reference's GroupManager,
+# ``collective.py:40``; actor methods may run on different threads).
+_process_groups: Dict[str, _GroupState] = {}
+_groups_lock = threading.Lock()
+
+
+def _groups() -> Dict[str, _GroupState]:
+    return _process_groups
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> None:
+    """Join a collective group (reference: ``collective.py:120``).
+
+    Call from every member actor/task with a distinct ``rank``. Rank 0
+    creates the named coordinator actor; others look it up.
+    """
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    actor_name = _GROUP_ACTOR_PREFIX + group_name
+    coordinator = None
+    if rank == 0:
+        coordinator = _Coordinator.options(name=actor_name).remote(world_size)
+        # touch it so registration completes before others look it up
+        get(coordinator.take.remote(-1, "warmup"))
+    else:
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                coordinator = get_actor(actor_name)
+                break
+            except ValueError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"collective group {group_name!r}: coordinator "
+                        "never appeared (is rank 0 up?)")
+                time.sleep(0.02)
+    with _groups_lock:
+        _process_groups[group_name] = _GroupState(group_name, world_size,
+                                                  rank, coordinator)
+
+
+class CollectiveActorMixin:
+    """Mix into an actor class to make it driveable by
+    ``create_collective_group`` (and get convenience methods)."""
+
+    def _rtpu_init_collective(self, world_size: int, rank: int,
+                              group_name: str) -> None:
+        init_collective_group(world_size, rank, group_name)
+
+
+def create_collective_group(actors: List[Any], world_size: int,
+                            ranks: List[int],
+                            group_name: str = "default") -> None:
+    """Driver-side declarative setup (reference: ``collective.py:177``):
+    instructs each actor to call ``init_collective_group``. Actor classes
+    must inherit ``CollectiveActorMixin`` (or expose an equivalent
+    ``_rtpu_init_collective`` method).
+
+    Rank 0's init creates the coordinator and later ranks block on its
+    appearance, so all members are driven concurrently here.
+    """
+    if len(actors) != world_size or len(ranks) != world_size:
+        raise ValueError(
+            f"need exactly world_size={world_size} actors and ranks, got "
+            f"{len(actors)} actors / {len(ranks)} ranks")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(f"ranks must be a permutation of 0..{world_size-1}, "
+                         f"got {ranks}")
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor._rtpu_init_collective.remote(world_size, rank,
+                                                       group_name))
+    get(refs)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _groups_lock:
+        state = _process_groups.pop(group_name, None)
+    if state is not None and state.rank == 0:
+        from .. import kill
+        try:
+            kill(state.coordinator)
+        except Exception:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    state = _groups().get(group_name)
+    return -1 if state is None else state.rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    state = _groups().get(group_name)
+    return -1 if state is None else state.world_size
+
+
+def _state(group_name: str) -> _GroupState:
+    state = _groups().get(group_name)
+    if state is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            "process; call init_collective_group first")
+    return state
+
+
+def _rendezvous(state: _GroupState, kind: str, payload, op: Optional[str],
+                timeout: float = 60.0):
+    key = (kind, state.seq)
+    state.seq += 1
+    get(state.coordinator.contribute.remote(key, state.rank, payload))
+    deadline = time.monotonic() + timeout
+    delay = 0.001
+    while True:
+        done, result = get(state.coordinator.poll.remote(key, op))
+        if done:
+            state.coordinator.ack.remote(key, state.rank)
+            return result
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective {kind} in group {state.name!r} timed out "
+                f"(rank {state.rank})")
+        time.sleep(delay)
+        delay = min(delay * 2, 0.05)
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = SUM):
+    """All-reduce; returns the reduced array (reference mutates in place —
+    functional style here, jax arrays are immutable)."""
+    state = _state(group_name)
+    arr = _to_numpy(tensor)
+    # Large payloads ride the object store; the coordinator sees refs
+    # transparently because args are resolved at task execution.
+    result = _rendezvous(state, "allreduce", put(arr), op)
+    return result
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    state = _state(group_name)
+    parts = _rendezvous(state, "allgather", put(_to_numpy(tensor)), None)
+    return [np.asarray(p) for p in parts]
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = SUM):
+    """Reduce then return this rank's 1/world_size slice along axis 0."""
+    state = _state(group_name)
+    reduced = np.asarray(_rendezvous(state, "reducescatter",
+                                     put(_to_numpy(tensor)), op))
+    if reduced.shape[0] % state.world_size:
+        raise ValueError(
+            f"reducescatter: leading dim {reduced.shape[0]} not divisible "
+            f"by world size {state.world_size}")
+    chunk = reduced.shape[0] // state.world_size
+    return reduced[state.rank * chunk:(state.rank + 1) * chunk]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    state = _state(group_name)
+    payload = put(_to_numpy(tensor)) if state.rank == src_rank else None
+    parts = _rendezvous(state, "broadcast", payload, None)
+    return np.asarray(parts[src_rank])
+
+
+def barrier(group_name: str = "default") -> None:
+    state = _state(group_name)
+    _rendezvous(state, "barrier", None, None)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    state = _state(group_name)
+    seq = state.send_seq.get((dst_rank, tag), 0)
+    state.send_seq[(dst_rank, tag)] = seq + 1
+    get(state.coordinator.post.remote(
+        dst_rank, (state.rank, tag, seq), put(_to_numpy(tensor))))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0,
+         timeout: float = 60.0):
+    state = _state(group_name)
+    seq = state.recv_seq.get((src_rank, tag), 0)
+    state.recv_seq[(src_rank, tag)] = seq + 1
+    deadline = time.monotonic() + timeout
+    delay = 0.001
+    while True:
+        ok, value = get(state.coordinator.take.remote(
+            state.rank, (src_rank, tag, seq)))
+        if ok:
+            return np.asarray(value)
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"recv from rank {src_rank} timed out")
+        time.sleep(delay)
+        delay = min(delay * 2, 0.05)

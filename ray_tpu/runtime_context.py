@@ -13,6 +13,7 @@ from ._private import context
 class RuntimeContext:
     job_id: object
     worker_id: object
+    node_id: Optional[object]
     task_id: Optional[object]
     actor_id: Optional[object]
     in_worker: bool
@@ -22,6 +23,9 @@ class RuntimeContext:
 
     def get_worker_id(self):
         return self.worker_id
+
+    def get_node_id(self):
+        return self.node_id
 
     def get_task_id(self):
         return self.task_id
@@ -35,6 +39,7 @@ def get_runtime_context() -> RuntimeContext:
     return RuntimeContext(
         job_id=client.job_id,
         worker_id=client.worker_id,
+        node_id=getattr(client, "node_id", None),
         task_id=context.current_task_id,
         actor_id=context.current_actor_id,
         in_worker=context.in_worker,

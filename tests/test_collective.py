@@ -1,0 +1,98 @@
+"""Host-level collective group tests (reference model:
+``python/ray/util/collective/tests/`` distributed multi-process variants).
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.comm import MeshGroup, mesh_group
+from ray_tpu.comm.collective import CollectiveActorMixin
+from ray_tpu.comm.device_mesh import SPMDWorkerBase
+
+
+def _make_worker():
+    import ray_tpu
+    from ray_tpu.comm import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member(col.CollectiveActorMixin):
+        def __init__(self):
+            self.value = None
+
+        def do_allreduce(self, x):
+            return col.allreduce(np.asarray(x, np.float32))
+
+        def do_allgather(self, x):
+            return col.allgather(np.asarray(x, np.float32))
+
+        def do_reducescatter(self, x):
+            return col.reducescatter(np.asarray(x, np.float32))
+
+        def do_broadcast(self, x):
+            payload = np.asarray(x, np.float32) if col.get_rank() == 0 \
+                else np.zeros(2, np.float32)
+            return col.broadcast(payload, src_rank=0)
+
+        def do_sendrecv(self):
+            rank = col.get_rank()
+            if rank == 0:
+                col.send(np.arange(4, dtype=np.float32), dst_rank=1)
+                return None
+            return col.recv(src_rank=0)
+
+    return Member
+
+
+def test_collective_ops(rtpu_init):
+    from ray_tpu.comm import collective as col
+    Member = _make_worker()
+    members = [Member.remote() for _ in range(3)]
+    col.create_collective_group(members, 3, [0, 1, 2])
+
+    out = ray_tpu.get([m.do_allreduce.remote([float(i + 1)] * 4)
+                       for i, m in enumerate(members)])
+    for arr in out:
+        np.testing.assert_allclose(np.asarray(arr), [6.0] * 4)
+
+    gathered = ray_tpu.get([m.do_allgather.remote([float(i)])
+                            for i, m in enumerate(members)])
+    for parts in gathered:
+        np.testing.assert_allclose(np.concatenate(parts), [0.0, 1.0, 2.0])
+
+    scattered = ray_tpu.get([m.do_reducescatter.remote(
+        np.full(6, float(i + 1))) for i, m in enumerate(members)])
+    for rank, part in enumerate(scattered):
+        np.testing.assert_allclose(part, [6.0, 6.0][:2])
+        assert part.shape == (2,)
+
+    bcast = ray_tpu.get([m.do_broadcast.remote([7.0, 8.0])
+                         for m in members])
+    for arr in bcast:
+        np.testing.assert_allclose(arr, [7.0, 8.0])
+
+
+def test_collective_sendrecv(rtpu_init):
+    from ray_tpu.comm import collective as col
+    Member = _make_worker()
+    members = [Member.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1])
+    results = ray_tpu.get([m.do_sendrecv.remote() for m in members])
+    np.testing.assert_allclose(results[1], np.arange(4, dtype=np.float32))
+
+
+def test_mesh_group(rtpu_init):
+    @ray_tpu.remote(num_cpus=1)
+    class Host(SPMDWorkerBase):
+        def rank_and_world(self):
+            return (self.mesh_rank, self.mesh_world)
+
+        def compute(self, x):
+            return x * (self.mesh_rank + 1)
+
+    group = mesh_group(Host, num_hosts=2,
+                       resources_per_host={"CPU": 1},
+                       strategy="PACK")
+    assert group.world_size == 2
+    assert group.run("rank_and_world") == [(0, 2), (1, 2)]
+    assert group.run("compute", 10) == [10, 20]
+    group.shutdown()
