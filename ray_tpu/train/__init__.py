@@ -1,0 +1,29 @@
+"""ray_tpu.train — distributed training orchestration, TPU-first.
+
+Reference: Ray Train (``python/ray/train/``, SURVEY §2.3/§3.4). The
+reference spawns N single-GPU worker processes and wires them into a
+torch NCCL process group; TPU-native the unit of placement is the *host*
+(4 chips each) and the unit of computation is ONE jitted SPMD program
+over a `jax.sharding.Mesh` covering the slice — so `JaxTrainer` gangs
+one worker actor per host, assembles a global mesh (jax.distributed on
+real pods, local devices in tests), and runs the user's
+``train_loop_per_worker`` in lockstep on every host.
+
+Parallelism (dp/fsdp/tp/sp/pp/ep) is a `MeshSpec` in ScalingConfig, not
+a wrapper class — see ``ray_tpu.parallel``.
+"""
+
+from .checkpoint import Checkpoint  # noqa: F401
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .result import Result  # noqa: F401
+from .session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    report,
+)
+from .trainer import JaxTrainer  # noqa: F401

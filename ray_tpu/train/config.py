@@ -1,0 +1,59 @@
+"""Trainer configuration dataclasses.
+
+Reference: ``python/ray/air/config.py:94`` (ScalingConfig), ``:723``
+(RunConfig), ``:523`` (FailureConfig), ``:574`` (CheckpointConfig). The
+TPU-shaped addition: ``ScalingConfig.mesh`` — a `MeshSpec` describing
+the global device mesh the worker gang assembles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many host workers, what resources each, what device mesh.
+
+    num_workers: one per TPU host (4 chips/host on v5e); CPU-only
+    training uses plain actors. ``use_tpu`` adds the TPU resource to each
+    bundle so gang placement lands on TPU hosts.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "STRICT_SPREAD"
+    mesh: Optional[MeshSpec] = None
+
+    def bundle(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 4.0)     # chips per host
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: worker-gang restarts before giving up; -1 = infinite."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 0

@@ -1,0 +1,85 @@
+"""Per-worker training session (reference: ``train/_internal/session.py``
+— ``_TrainSession.report`` :612; user API ``ray.train.report`` /
+``get_context()``).
+
+Workers call ``report(metrics, checkpoint=...)`` each epoch/interval;
+results stream back to the trainer through a driver-owned results queue.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_session_local = threading.local()
+
+
+class TrainContext:
+    def __init__(self, world_rank: int, world_size: int,
+                 results_queue, latest_checkpoint: Optional[Checkpoint],
+                 config: Optional[Dict[str, Any]] = None,
+                 storage_path: Optional[str] = None,
+                 experiment_name: str = "train"):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.results_queue = results_queue
+        self.latest_checkpoint = latest_checkpoint
+        self.config = config or {}
+        self.storage_path = storage_path
+        self.experiment_name = experiment_name
+        self.iteration = 0
+
+    # reference: ray.train.get_context() surface
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.world_rank   # one worker per host
+
+    def get_trial_name(self) -> str:
+        return self.experiment_name
+
+
+def _set_session(ctx: Optional[TrainContext]) -> None:
+    _session_local.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_session_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError(
+            "not inside a train session (call from train_loop_per_worker)")
+    return ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) for this iteration.
+
+    Rank 0's checkpoint is persisted; other ranks' checkpoints are
+    ignored (TPU SPMD state is replicated or resharded on restore, so
+    one host's copy suffices — pass fully-addressable trees).
+    """
+    ctx = get_context()
+    ctx.iteration += 1
+    payload = {
+        "rank": ctx.world_rank,
+        "iteration": ctx.iteration,
+        "metrics": dict(metrics),
+        "checkpoint_path": None,
+    }
+    if checkpoint is not None and ctx.world_rank == 0:
+        checkpoint.set_metrics(metrics)
+        payload["checkpoint_path"] = checkpoint.path
+        ctx.latest_checkpoint = checkpoint
+    ctx.results_queue.put(payload)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Latest checkpoint to resume from (set on restart after failure)."""
+    return get_context().latest_checkpoint

@@ -1,0 +1,264 @@
+"""JaxTrainer — gang-scheduled SPMD training driver.
+
+Reference flow (SURVEY §3.4): ``BaseTrainer.fit``
+(``train/base_trainer.py:608``) → ``BackendExecutor``
+(``_internal/backend_executor.py:46``) → ``WorkerGroup``
+(``_internal/worker_group.py:101``) spawns N worker actors in a
+placement-group gang, sets up a torch process group, runs
+``train_loop_per_worker``, streams ``session.report`` results back.
+
+TPU-native differences:
+  * one worker per *host*, not per chip; inside each worker the user
+    builds (or receives) a `jax.sharding.Mesh` over the host's devices —
+    on a real pod `jax.distributed.initialize` stitches hosts into one
+    global mesh (multi-controller SPMD); no NCCL/TCPStore rendezvous.
+  * parallelism comes from `ScalingConfig.mesh` (a MeshSpec), not from
+    DDP/FSDP wrapper classes.
+  * failure handling is checkpoint-based elastic restart: on worker
+    death the whole gang restarts from the last reported checkpoint
+    (SPMD programs can't lose a single participant).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import get, kill, wait
+from ..api import remote
+from ..exceptions import TaskError, WorkerCrashedError
+from ..util.placement_group import placement_group, remove_placement_group
+from ..util.scheduling_strategies import PlacementGroupSchedulingStrategy
+from .checkpoint import Checkpoint
+from .config import (CheckpointConfig, FailureConfig, RunConfig,
+                     ScalingConfig)
+from .result import Result
+from .session import TrainContext, _set_session
+
+
+@remote
+class _TrainWorker:
+    """One gang member; executes the user loop under a session."""
+
+    def __init__(self, rank: int, world_size: int, storage_path: str,
+                 experiment_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.storage_path = storage_path
+        self.experiment_name = experiment_name
+
+    def run(self, loop_fn: Callable, config: Dict[str, Any],
+            results_queue, resume_ckpt_path: Optional[str]):
+        resume = (Checkpoint(resume_ckpt_path)
+                  if resume_ckpt_path else None)
+        ctx = TrainContext(self.rank, self.world_size, results_queue,
+                           resume, config=config,
+                           storage_path=self.storage_path,
+                           experiment_name=self.experiment_name)
+        _set_session(ctx)
+        try:
+            if _loop_takes_config(loop_fn):
+                loop_fn(config)
+            else:
+                loop_fn()
+        finally:
+            _set_session(None)
+        return self.rank
+
+
+def _loop_takes_config(fn: Callable) -> bool:
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return len([p for p in params.values()
+                if p.default is inspect.Parameter.empty
+                and p.kind in (p.POSITIONAL_ONLY,
+                               p.POSITIONAL_OR_KEYWORD)]) >= 1
+
+
+class JaxTrainer:
+    """Run ``train_loop_per_worker`` on a gang of host workers.
+
+    train_loop_per_worker: callable taking (config) or (); uses
+    ``ray_tpu.train.report`` / ``get_checkpoint`` / ``get_context``.
+    """
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._loop = train_loop_per_worker
+        self._loop_config = train_loop_config or {}
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._failure = self._run_config.failure_config or FailureConfig()
+        self._ckpt_config = (self._run_config.checkpoint_config
+                             or CheckpointConfig())
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        from ..util.queue import Queue
+
+        name = self._run_config.name or "jax_train"
+        storage = self._run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "rtpu_results")
+        exp_dir = os.path.join(storage, name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        attempts = 0
+        latest_ckpt: Optional[Checkpoint] = None
+        history: List[Dict[str, Any]] = []
+        last_metrics: Dict[str, Any] = {}
+        saved_ckpts: List[str] = []
+        error: Optional[Exception] = None
+
+        while True:
+            queue = Queue()
+            gang = self._spawn_gang(name, storage)
+            try:
+                refs = [w.run.remote(self._loop, self._loop_config, queue,
+                                     latest_ckpt.path if latest_ckpt
+                                     else None)
+                        for w in gang["workers"]]
+                pending = list(refs)
+                while pending:
+                    _drain(queue, exp_dir, saved_ckpts, self._ckpt_config,
+                           history)
+                    latest_ckpt, last_metrics = _latest(history, latest_ckpt,
+                                                        last_metrics)
+                    done, pending = wait(pending,
+                                         num_returns=len(pending),
+                                         timeout=0.05)
+                    for ref in done:
+                        get(ref)        # surface worker exceptions
+                _drain(queue, exp_dir, saved_ckpts, self._ckpt_config,
+                       history)
+                latest_ckpt, last_metrics = _latest(history, latest_ckpt,
+                                                    last_metrics)
+                error = None
+                break
+            except (TaskError, WorkerCrashedError) as e:
+                # capture reports that landed before the crash — the last
+                # checkpoint is the restart point
+                try:
+                    _drain(queue, exp_dir, saved_ckpts, self._ckpt_config,
+                           history)
+                    latest_ckpt, last_metrics = _latest(
+                        history, latest_ckpt, last_metrics)
+                except Exception:
+                    pass
+                attempts += 1
+                budget = self._failure.max_failures
+                if budget >= 0 and attempts > budget:
+                    error = e
+                    break
+                # elastic restart from last checkpoint
+            finally:
+                self._teardown_gang(gang)
+                try:
+                    queue.shutdown()
+                except Exception:
+                    pass
+
+        # surface the persisted copy of the final checkpoint if any
+        final_ckpt = Checkpoint(saved_ckpts[-1]) if saved_ckpts else \
+            latest_ckpt
+        return Result(metrics=last_metrics, checkpoint=final_ckpt,
+                      path=exp_dir, error=error,
+                      metrics_history=[h["metrics"] for h in history
+                                       if h["rank"] == 0])
+
+    # ------------------------------------------------------------- plumbing
+    def _spawn_gang(self, name: str, storage: str) -> dict:
+        sc = self._scaling
+        bundle = sc.bundle()
+        pg = placement_group([bundle] * sc.num_workers,
+                             strategy=sc.placement_strategy)
+        try:
+            pg.ready(timeout=60.0)
+        except TimeoutError:
+            if sc.placement_strategy == "STRICT_SPREAD":
+                # dev fallback: fewer nodes than workers — pack instead
+                remove_placement_group(pg)
+                pg = placement_group([bundle] * sc.num_workers,
+                                     strategy="PACK")
+                pg.ready(timeout=60.0)
+            else:
+                raise
+        workers = []
+        try:
+            for rank in range(sc.num_workers):
+                strat = PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=rank)
+                opts = {"scheduling_strategy": strat,
+                        "num_cpus": bundle.get("CPU", 1.0)}
+                extra = {k: v for k, v in bundle.items() if k != "CPU"}
+                if extra:
+                    opts["resources"] = extra
+                workers.append(_TrainWorker.options(**opts).remote(
+                    rank, sc.num_workers, storage, name))
+            return {"pg": pg, "workers": workers}
+        except Exception:
+            for w in workers:
+                try:
+                    kill(w)
+                except Exception:
+                    pass
+            remove_placement_group(pg)
+            raise
+
+    def _teardown_gang(self, gang: dict) -> None:
+        for w in gang.get("workers", ()):
+            try:
+                kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(gang["pg"])
+        except Exception:
+            pass
+
+
+def _latest(history, latest_ckpt, last_metrics):
+    """Rank-0's most recent report drives Result metrics/checkpoint."""
+    for payload in reversed(history):
+        if payload["rank"] == 0:
+            last_metrics = payload["metrics"]
+            if payload.get("checkpoint_path"):
+                latest_ckpt = Checkpoint(payload["checkpoint_path"])
+            break
+    return latest_ckpt, last_metrics
+
+
+def _drain(queue, exp_dir: str, saved: List[str],
+           ckpt_config: CheckpointConfig,
+           history: List[Dict[str, Any]]) -> None:
+    """Pull all pending reports; persist rank-0 checkpoints into the
+    experiment dir (checkpoint_000N) honoring num_to_keep."""
+    from ..util.queue import Empty
+    while True:
+        try:
+            payload = queue.get_nowait()
+        except Empty:
+            break
+        history.append(payload)
+        src = payload.get("checkpoint_path")
+        if src and os.path.isdir(src):
+            dst = os.path.join(exp_dir,
+                               f"checkpoint_{len(saved):06d}")
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(src, dst)
+            payload["checkpoint_path"] = dst
+            saved.append(dst)
+            keep = ckpt_config.num_to_keep
+            if keep and len(saved) > keep:
+                for old in saved[:-keep]:
+                    shutil.rmtree(old, ignore_errors=True)
+                del saved[:-keep]

@@ -1,0 +1,131 @@
+"""JaxTrainer tests (reference model: ``python/ray/train/tests/`` —
+trainer fit, session report, checkpointing, failure restart)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu.train import (Checkpoint, CheckpointConfig, FailureConfig,
+                           JaxTrainer, RunConfig, ScalingConfig)
+
+
+def test_fit_reports_metrics(rtpu_init, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+        ctx = train.get_context()
+        for i in range(3):
+            train.report({"loss": 1.0 / (i + 1),
+                          "rank": ctx.get_world_rank()})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     placement_strategy="PACK"),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] == pytest.approx(1.0 / 3)
+    assert len(result.metrics_history) == 3
+    assert result.metrics["rank"] == 0
+
+
+def test_fit_persists_checkpoints(rtpu_init, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+        ctx = train.get_context()
+        for i in range(2):
+            ckpt = None
+            if ctx.get_world_rank() == 0:
+                ckpt = Checkpoint.from_dict({"step": i})
+            train.report({"step": i}, checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path),
+                             checkpoint_config=CheckpointConfig(
+                                 num_to_keep=1)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict() == {"step": 1}
+    # num_to_keep=1: only one checkpoint dir remains
+    dirs = [d for d in os.listdir(result.path)
+            if d.startswith("checkpoint_")]
+    assert len(dirs) == 1
+
+
+def test_failure_restart_resumes_from_checkpoint(rtpu_init, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+        ctx = train.get_context()
+        start = 0
+        resume = train.get_checkpoint()
+        if resume is not None:
+            start = resume.to_dict()["step"] + 1
+        for i in range(start, 4):
+            ckpt = (Checkpoint.from_dict({"step": i})
+                    if ctx.get_world_rank() == 0 else None)
+            train.report({"step": i, "resumed": start > 0},
+                         checkpoint=ckpt)
+            if i == 1 and start == 0:
+                raise RuntimeError("injected failure at step 1")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t3", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    assert result.metrics["resumed"] is True
+
+
+def test_failure_budget_exhausted(rtpu_init, tmp_path):
+    def loop():
+        raise ValueError("always fails")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t4", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)))
+    result = trainer.fit()
+    assert result.error is not None
+
+
+def test_jax_training_with_pytree_checkpoint(rtpu_init, tmp_path):
+    def loop(config):
+        import jax
+        import numpy as np
+        from ray_tpu import train
+        from ray_tpu.models import (GPT, llama_tiny, init_train_state,
+                                    make_optimizer, make_train_step)
+
+        cfg = llama_tiny()
+        model = GPT(cfg)
+        opt = make_optimizer(total_steps=4)
+        state = init_train_state(model, opt, jax.random.PRNGKey(0))
+        step = make_train_step(model, opt)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                    cfg.vocab_size)
+        for i in range(2):
+            state, metrics = step(state, {"tokens": tokens})
+            ckpt = train.Checkpoint.from_pytree(
+                {"params": state.params, "step": np.asarray(state.step)})
+            train.report({"loss": float(metrics["loss"])}, checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t5", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] > 0
+    restored = result.checkpoint.to_pytree()
+    assert int(restored["step"]) == 2
+    assert "tok_embed" in restored["params"]
