@@ -1,0 +1,122 @@
+"""Tune tests (reference model: ``python/ray/tune/tests/`` — variant
+generation, trial execution, ASHA early stop, PBT exploit, resume)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, RunConfig
+from ray_tpu.tune import (ASHAScheduler, PopulationBasedTraining,
+                          TuneConfig, Tuner)
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+def test_variant_generator_grid_and_random():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.grid_search([0.0, 0.1]),
+        "seed": tune.randint(0, 1000),
+        "nested": {"dropout": tune.uniform(0.0, 0.5)},
+        "static": 7,
+    }
+    variants = list(BasicVariantGenerator(space, num_samples=3).variants())
+    assert len(variants) == 12          # 2 x 2 grid x 3 samples
+    for v in variants:
+        assert v["lr"] in (0.1, 0.01) and v["wd"] in (0.0, 0.1)
+        assert 0 <= v["seed"] < 1000
+        assert 0.0 <= v["nested"]["dropout"] <= 0.5
+        assert v["static"] == 7
+
+
+def test_tuner_minimizes(rtpu_init, tmp_path):
+    def objective(config):
+        score = (config["x"] - 3) ** 2
+        tune.report({"score": score})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="min",
+                               max_concurrent_trials=3),
+        run_config=RunConfig(name="quad", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 0
+
+
+def test_asha_early_stops_bad_trials(rtpu_init, tmp_path):
+    def objective(config):
+        import time
+        for i in range(9):
+            # paced so the controller can intervene between reports
+            time.sleep(0.1)
+            tune.report({"loss": config["level"] + 1.0 / (i + 1)})
+
+    tuner = Tuner(
+        objective,
+        param_space={"level": tune.grid_search([0.0, 5.0, 10.0, 20.0])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=4,
+            scheduler=ASHAScheduler(metric="loss", mode="min", max_t=9,
+                                    grace_period=2,
+                                    reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["loss"] < 2.0
+    # at least one poor trial must have been cut before 9 iterations
+    lengths = [len(r.metrics_history) for r in grid]
+    assert min(lengths) < 9
+    assert max(lengths) == 9
+
+
+def test_pbt_exploits(rtpu_init, tmp_path):
+    def objective(config):
+        resume = tune.get_checkpoint()
+        score = resume.to_dict()["score"] if resume else 0.0
+        for _ in range(6):
+            score += config["rate"]
+            tune.report({"score": score},
+                        checkpoint=Checkpoint.from_dict({"score": score}))
+
+    tuner = Tuner(
+        objective,
+        param_space={"rate": tune.grid_search([0.1, 1.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=2,
+            scheduler=PopulationBasedTraining(
+                metric="score", mode="max", perturbation_interval=2,
+                hyperparam_mutations={"rate": [0.1, 1.0, 2.0]},
+                quantile_fraction=0.5)),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 6.0
+
+
+def test_tuner_restore_reruns_unfinished(rtpu_init, tmp_path):
+    marker = os.path.join(str(tmp_path), "fail_once")
+    open(marker, "w").close()
+
+    def objective(config):
+        if config["x"] == 1 and os.path.exists(marker):
+            raise RuntimeError("flaky")
+        tune.report({"score": config["x"]})
+
+    run = RunConfig(name="resume", storage_path=str(tmp_path))
+    tuner = Tuner(objective,
+                  param_space={"x": tune.grid_search([0, 1])},
+                  tune_config=TuneConfig(metric="score", mode="max"),
+                  run_config=run)
+    grid = tuner.fit()
+    assert len(grid.errors) == 1
+
+    os.remove(marker)
+    restored = Tuner.restore(os.path.join(str(tmp_path), "resume"),
+                             objective)
+    grid2 = restored.fit()
+    assert not grid2.errors
+    assert grid2.get_best_result().metrics["score"] == 1
