@@ -1,0 +1,22 @@
+"""ray_tpu.data — streaming distributed datasets.
+
+Reference: Ray Data (``python/ray/data/``, SURVEY §2.3): a lazy logical
+plan of operators executed by a backpressure-aware streaming executor
+over blocks in the object store (``_internal/execution/
+streaming_executor.py:49``). Here blocks are columnar dicts of numpy
+arrays in the shm object store; transforms run as tasks with a bounded
+in-flight window; the TPU-shaped addition is double-buffered device
+prefetch (``Dataset.iter_device_batches``) feeding jax arrays straight
+onto the chips.
+"""
+
+from .dataset import Dataset  # noqa: F401
+from .read_api import (  # noqa: F401
+    from_items,
+    from_numpy,
+    range,
+    range_tensor,
+    read_csv,
+    read_json,
+    read_parquet,
+)

@@ -1,0 +1,301 @@
+"""Dataset: lazy logical plan + streaming execution over block tasks.
+
+Reference: ``python/ray/data/dataset.py:178`` (API surface),
+``_internal/plan.py`` (logical plan), ``_internal/execution/
+streaming_executor.py:49`` (backpressure-aware streaming execution),
+``_internal/execution/operators/map_operator.py:39`` (fused map tasks).
+
+Execution model here: row/batch transforms fuse into one remote task per
+block (one pass through the object store per stage-chain, like the
+reference's operator fusion); the driver keeps a bounded window of
+in-flight block tasks (backpressure) and yields blocks in order.
+All-to-all ops (repartition / random_shuffle) are barriers that
+redistribute materialized block refs with slice/concat tasks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple)
+
+import numpy as np
+
+from .. import get, put, wait
+from ..api import remote
+from . import block as B
+
+Block = B.Block
+
+_DEFAULT_WINDOW = 8
+
+
+# A stage is ("map_batches"|"map"|"filter"|"flat_map", fn, kwargs)
+Stage = Tuple[str, Callable, dict]
+
+
+def _apply_stages(blk: Block, stages: Sequence[Stage]) -> Block:
+    for kind, fn, kw in stages:
+        if kind == "map_batches":
+            fmt = kw.get("batch_format", "numpy")
+            out = fn(dict(blk) if fmt == "numpy" else list(B.block_rows(blk)))
+            blk = B.normalize_block(out)
+        elif kind == "map":
+            blk = B.block_from_rows([fn(r) for r in B.block_rows(blk)])
+        elif kind == "filter":
+            keep = [i for i, r in enumerate(B.block_rows(blk)) if fn(r)]
+            blk = B.block_take(blk, np.asarray(keep, np.int64)) if keep \
+                else {k: v[:0] for k, v in blk.items()}
+        elif kind == "flat_map":
+            rows = list(itertools.chain.from_iterable(
+                fn(r) for r in B.block_rows(blk)))
+            blk = B.block_from_rows(rows)
+        else:
+            raise ValueError(f"unknown stage kind {kind}")
+    return blk
+
+
+@remote
+def _run_block_task(source_fn: Optional[Callable], source_block,
+                    stages: List[Stage]) -> Block:
+    blk = source_fn() if source_fn is not None else source_block
+    blk = B.normalize_block(blk)
+    return _apply_stages(blk, stages)
+
+
+@remote
+def _concat_blocks(*blocks: Block) -> Block:
+    return B.block_concat(list(blocks))
+
+
+@remote
+def _slice_block(blk: Block, start: int, stop: int) -> Block:
+    return B.block_slice(blk, start, stop)
+
+
+@remote
+def _shuffle_block(blk: Block, seed: int) -> Block:
+    rng = np.random.default_rng(seed)
+    n = B.block_num_rows(blk)
+    return B.block_take(blk, rng.permutation(n))
+
+
+class Dataset:
+    """Lazy; chainable; executed streaming on iteration/consumption."""
+
+    def __init__(self,
+                 sources: Optional[List[Callable[[], Block]]] = None,
+                 block_refs: Optional[List[Any]] = None,
+                 stages: Optional[List[Stage]] = None):
+        # exactly one of sources (unread) / block_refs (materialized input)
+        self._sources = sources
+        self._block_refs = block_refs
+        self._stages = stages or []
+
+    # ------------------------------------------------------------ transforms
+    def _with_stage(self, stage: Stage) -> "Dataset":
+        return Dataset(self._sources, self._block_refs,
+                       self._stages + [stage])
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    **kw) -> "Dataset":
+        return self._with_stage(("map_batches", fn,
+                                 {"batch_format": batch_format}))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with_stage(("map", fn, {}))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with_stage(("filter", fn, {}))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with_stage(("flat_map", fn, {}))
+
+    # ------------------------------------------------------------- execution
+    def _num_input_blocks(self) -> int:
+        return len(self._sources if self._sources is not None
+                   else self._block_refs or [])
+
+    def streaming_block_refs(self,
+                             window: int = _DEFAULT_WINDOW
+                             ) -> Iterator[Any]:
+        """The streaming executor: bounded in-flight block tasks,
+        blocks yielded in input order (backpressure = stop submitting
+        when `window` results are unconsumed)."""
+        inputs: List[Tuple[Optional[Callable], Any]]
+        if self._sources is not None:
+            inputs = [(fn, None) for fn in self._sources]
+        else:
+            inputs = [(None, ref) for ref in (self._block_refs or [])]
+        if not self._stages and self._sources is None:
+            yield from (ref for _, ref in inputs)
+            return
+        in_flight: List[Any] = []
+        it = iter(inputs)
+        exhausted = False
+        while in_flight or not exhausted:
+            while not exhausted and len(in_flight) < window:
+                try:
+                    src_fn, src_ref = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                in_flight.append(_run_block_task.remote(
+                    src_fn, src_ref, self._stages))
+            if in_flight:
+                head = in_flight.pop(0)
+                wait([head], num_returns=1, timeout=None)
+                yield head
+
+    def materialize(self) -> "Dataset":
+        refs = list(self.streaming_block_refs())
+        return Dataset(block_refs=refs)
+
+    # ------------------------------------------------------------ all-to-all
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Barrier: equalize rows over num_blocks output blocks."""
+        mat = self.materialize()
+        refs = mat._block_refs or []
+        counts = [B.block_num_rows(b) for b in get(refs)] if refs else []
+        total = sum(counts)
+        per = total // num_blocks
+        sizes = [per + (1 if i < total % num_blocks else 0)
+                 for i in range(num_blocks)]
+        # assemble each output from input slices without driver transfer
+        out_refs = []
+        in_idx, in_off = 0, 0
+        for size in sizes:
+            parts = []
+            need = size
+            while need > 0 and in_idx < len(refs):
+                avail = counts[in_idx] - in_off
+                take = min(avail, need)
+                if take > 0:
+                    parts.append(_slice_block.remote(
+                        refs[in_idx], in_off, in_off + take))
+                    in_off += take
+                    need -= take
+                if in_off >= counts[in_idx]:
+                    in_idx += 1
+                    in_off = 0
+            out_refs.append(_concat_blocks.remote(*parts) if len(parts) != 1
+                            else parts[0])
+        return Dataset(block_refs=out_refs)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """Block-order permutation + intra-block shuffle (the reference's
+        push-based full shuffle is a scale feature; this is the standard
+        approximation for training-ingest pipelines)."""
+        mat = self.materialize()
+        refs = list(mat._block_refs or [])
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(refs))
+        shuffled = [_shuffle_block.remote(refs[i], int(rng.integers(2**31)))
+                    for i in order]
+        return Dataset(block_refs=shuffled)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n datasets by round-robin over blocks (reference:
+        ``Dataset.split`` for per-worker ingest)."""
+        mat = self.materialize()
+        refs = mat._block_refs or []
+        return [Dataset(block_refs=refs[i::n]) for i in range(n)]
+
+    def limit(self, n: int) -> "Dataset":
+        out_refs = []
+        remaining = n
+        for ref in self.streaming_block_refs():
+            blk_rows = B.block_num_rows(get(ref))
+            if blk_rows <= remaining:
+                out_refs.append(ref)
+                remaining -= blk_rows
+            else:
+                out_refs.append(_slice_block.remote(ref, 0, remaining))
+                remaining = 0
+            if remaining <= 0:
+                break
+        return Dataset(block_refs=out_refs)
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(block_refs=(self.materialize()._block_refs
+                                   + other.materialize()._block_refs))
+
+    # ----------------------------------------------------------- consumption
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self.streaming_block_refs():
+            yield get(ref)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for blk in self.iter_blocks():
+            yield from B.block_rows(blk)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False) -> Iterator[Block]:
+        """Re-batch across block boundaries."""
+        buf: List[Block] = []
+        buffered = 0
+        for blk in self.iter_blocks():
+            if not B.block_num_rows(blk):
+                continue
+            buf.append(blk)
+            buffered += B.block_num_rows(blk)
+            while buffered >= batch_size:
+                merged = B.block_concat(buf)
+                yield B.block_slice(merged, 0, batch_size)
+                rest = B.block_slice(merged, batch_size,
+                                     B.block_num_rows(merged))
+                buf = [rest] if B.block_num_rows(rest) else []
+                buffered = B.block_num_rows(rest)
+        if buffered and not drop_last:
+            yield B.block_concat(buf)
+
+    def iter_device_batches(self, *, batch_size: int,
+                            sharding: Any = None,
+                            drop_last: bool = True) -> Iterator[Any]:
+        """Double-buffered device prefetch: host batch i+1 is transferred
+        while batch i computes (the TPU ingest pattern; reference
+        analogue: ``train/_internal/data_config.py`` streaming splits +
+        torch dataloader prefetch)."""
+        import jax
+
+        def to_device(blk: Block):
+            arrs = {k: jax.device_put(v, sharding) for k, v in blk.items()}
+            return arrs
+
+        it = self.iter_batches(batch_size=batch_size, drop_last=drop_last)
+        prev = None
+        for blk in it:
+            nxt = to_device(blk)       # async transfer starts now
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(B.block_num_rows(b) for b in self.iter_blocks())
+
+    def schema(self) -> Dict[str, str]:
+        for blk in self.iter_blocks():
+            if B.block_num_rows(blk):
+                return {k: str(v.dtype) for k, v in blk.items()}
+        return {}
+
+    def num_blocks(self) -> int:
+        return self._num_input_blocks()
+
+    def __repr__(self):
+        stages = "+".join(s[0] for s in self._stages) or "read"
+        return (f"Dataset(blocks={self._num_input_blocks()}, "
+                f"stages={stages})")

@@ -1,0 +1,154 @@
+"""Datasource readers (reference: ``python/ray/data/read_api.py`` +
+``data/datasource/`` parquet/csv/json readers). Each file (or range
+shard) becomes one read task — reads execute inside the streaming
+executor, not eagerly on the driver.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .block import Block, block_from_rows, normalize_block
+from .dataset import Dataset
+
+
+def _expand_paths(paths: Union[str, Sequence[str]],
+                  suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, f"*{suffix}" if suffix else "*")
+            out.extend(sorted(_glob.glob(pat)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def range(n: int, *, num_blocks: Optional[int] = None) -> Dataset:
+    num_blocks = num_blocks or min(max(1, n // 1000), 64)
+    bounds = np.linspace(0, n, num_blocks + 1, dtype=np.int64)
+
+    def make(lo: int, hi: int):
+        return {"id": np.arange(lo, hi, dtype=np.int64)}
+
+    return Dataset(sources=[functools.partial(make, int(lo), int(hi))
+                            for lo, hi in zip(bounds[:-1], bounds[1:])])
+
+
+def range_tensor(n: int, *, shape=(1,),
+                 num_blocks: Optional[int] = None) -> Dataset:
+    num_blocks = num_blocks or min(max(1, n // 1000), 64)
+    bounds = np.linspace(0, n, num_blocks + 1, dtype=np.int64)
+
+    def make(lo: int, hi: int):
+        base = np.arange(lo, hi, dtype=np.int64)
+        data = np.broadcast_to(base.reshape((-1,) + (1,) * len(shape)),
+                               (hi - lo,) + tuple(shape)).copy()
+        return {"data": data}
+
+    return Dataset(sources=[functools.partial(make, int(lo), int(hi))
+                            for lo, hi in zip(bounds[:-1], bounds[1:])])
+
+
+def from_items(items: Sequence[Any], *,
+               num_blocks: int = 4) -> Dataset:
+    items = list(items)
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    num_blocks = max(1, min(num_blocks, len(rows) or 1))
+    chunks = np.array_split(np.arange(len(rows)), num_blocks)
+
+    def make(idx: np.ndarray):
+        return block_from_rows([rows[i] for i in idx])
+
+    return Dataset(sources=[functools.partial(make, c) for c in chunks
+                            if len(c)])
+
+
+def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]],
+               *, num_blocks: int = 4) -> Dataset:
+    blk = normalize_block(arrays)
+    n = len(next(iter(blk.values()))) if blk else 0
+    num_blocks = max(1, min(num_blocks, n or 1))
+    bounds = np.linspace(0, n, num_blocks + 1, dtype=np.int64)
+
+    def make(lo: int, hi: int):
+        return {k: v[lo:hi] for k, v in blk.items()}
+
+    return Dataset(sources=[functools.partial(make, int(lo), int(hi))
+                            for lo, hi in zip(bounds[:-1], bounds[1:])])
+
+
+def read_csv(paths: Union[str, Sequence[str]], **kw) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    def read_one(path: str) -> Block:
+        import csv
+        with open(path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        blk = block_from_rows(rows)
+        # numeric columns parse as numbers (csv gives strings)
+        out = {}
+        for k, v in blk.items():
+            try:
+                out[k] = v.astype(np.int64)
+            except ValueError:
+                try:
+                    out[k] = v.astype(np.float64)
+                except ValueError:
+                    out[k] = v
+        return out
+
+    return Dataset(sources=[functools.partial(read_one, p) for p in files])
+
+
+def read_json(paths: Union[str, Sequence[str]], *, lines: bool = True,
+              **kw) -> Dataset:
+    files = _expand_paths(paths, ".jsonl" if lines else ".json")
+
+    def read_one(path: str) -> Block:
+        import json
+        with open(path) as f:
+            if lines:
+                rows = [json.loads(line) for line in f if line.strip()]
+            else:
+                data = json.load(f)
+                rows = data if isinstance(data, list) else [data]
+        return block_from_rows(rows)
+
+    return Dataset(sources=[functools.partial(read_one, p) for p in files])
+
+
+def read_parquet(paths: Union[str, Sequence[str]], *,
+                 columns: Optional[List[str]] = None, **kw) -> Dataset:
+    """Parquet via pyarrow if present, else torch-free fallback error.
+
+    (pyarrow ships with the baked pandas/pyarrow stack when available;
+    gated so the core package has no hard dependency.)
+    """
+    files = _expand_paths(paths, ".parquet")
+
+    def read_one(path: str) -> Block:
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:
+            raise ImportError(
+                "read_parquet requires pyarrow, which is not available "
+                "in this environment") from e
+        table = pq.read_table(path, columns=columns)
+        return {name: np.asarray(col)
+                for name, col in zip(table.column_names,
+                                     table.to_pydict().values())}
+
+    return Dataset(sources=[functools.partial(read_one, p) for p in files])
