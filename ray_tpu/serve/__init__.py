@@ -1,0 +1,23 @@
+"""ray_tpu.serve — model serving on actors.
+
+Reference: Ray Serve (``python/ray/serve/``, SURVEY §2.3/§3.5): a
+controller actor reconciles declarative deployment state into replica
+actors; handles/proxies route requests with power-of-two-choices on
+queue length; autoscaling reacts to queue metrics; ``@serve.batch``
+coalesces concurrent requests for batched inference — the essential
+feature for TPU replicas, where batch = MXU utilization.
+
+Surface: ``@serve.deployment`` → ``serve.run(app)`` → handle, plus an
+optional stdlib HTTP gateway (``serve.start_http``).
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http,
+)
+from .batching import batch  # noqa: F401
+from .handle import DeploymentHandle  # noqa: F401

@@ -1,0 +1,165 @@
+"""serve public API: @deployment, run, handles, HTTP gateway.
+
+Reference: ``serve/api.py:479`` (serve.run), ``:265`` (@serve.deployment),
+proxies ``_private/proxy.py``. The gateway here is stdlib http.server
+(JSON POST /{deployment}) — the reference's uvicorn/ASGI stack is an
+infra choice, not a semantic one; routing semantics (handle + p2c) are
+identical for HTTP and Python callers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from .. import get, get_actor, kill
+from .._private import serialization as ser
+from .controller import ServeController
+from .handle import DeploymentHandle
+
+_CONTROLLER_NAME = "rtpu:serve_controller"
+_http_server = None
+
+
+class Deployment:
+    """Declarative deployment spec; ``.bind(*args)`` makes an app."""
+
+    def __init__(self, target: Callable, name: str,
+                 num_replicas: int = 1,
+                 ray_actor_options: Optional[dict] = None,
+                 autoscaling_config: Optional[dict] = None,
+                 max_concurrent_queries: int = 8):
+        self._target = target
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options or {}
+        self.autoscaling_config = autoscaling_config
+        self.max_concurrent_queries = max_concurrent_queries
+
+    def options(self, **kwargs) -> "Deployment":
+        merged = dict(
+            name=self.name, num_replicas=self.num_replicas,
+            ray_actor_options=self.ray_actor_options,
+            autoscaling_config=self.autoscaling_config,
+            max_concurrent_queries=self.max_concurrent_queries)
+        merged.update(kwargs)
+        return Deployment(self._target, **merged)
+
+    def bind(self, *init_args, **init_kwargs) -> "Application":
+        return Application(self, init_args, init_kwargs)
+
+
+class Application:
+    def __init__(self, deployment: Deployment, init_args: tuple,
+                 init_kwargs: dict):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+
+def deployment(_target: Optional[Callable] = None, *,
+               name: Optional[str] = None, num_replicas: int = 1,
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict] = None,
+               max_concurrent_queries: int = 8):
+    """``@serve.deployment`` on a class (callable) or function."""
+
+    def wrap(target):
+        return Deployment(target, name or target.__name__,
+                          num_replicas=num_replicas,
+                          ray_actor_options=ray_actor_options,
+                          autoscaling_config=autoscaling_config,
+                          max_concurrent_queries=max_concurrent_queries)
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+def _get_or_create_controller():
+    try:
+        return get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        return ServeController.options(name=_CONTROLLER_NAME,
+                                       lifetime="detached").remote()
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy an application; blocks until replicas exist."""
+    dep = app.deployment
+    controller = _get_or_create_controller()
+    blob = ser.dumps_function(dep._target)
+    get(controller.deploy.remote(
+        dep.name, blob, app.init_args, app.init_kwargs,
+        dep.num_replicas, dep.ray_actor_options,
+        dep.autoscaling_config, dep.max_concurrent_queries))
+    return DeploymentHandle(dep.name, controller)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_or_create_controller())
+
+
+def delete(name: str) -> None:
+    controller = _get_or_create_controller()
+    get(controller.delete.remote(name))
+
+
+def shutdown() -> None:
+    global _http_server
+    if _http_server is not None:
+        _http_server.shutdown()
+        _http_server = None
+    try:
+        controller = get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        return
+    get(controller.shutdown.remote())
+    try:
+        kill(controller)
+    except Exception:
+        pass
+
+
+# ------------------------------------------------------------- HTTP gateway
+
+def start_http(host: str = "127.0.0.1", port: int = 8000) -> str:
+    """Minimal JSON gateway: POST /{deployment} with a JSON body calls
+    the deployment with the parsed body (reference: HTTPProxy
+    ``_private/proxy.py:912``)."""
+    global _http_server
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    handles: Dict[str, DeploymentHandle] = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            name = self.path.strip("/").split("/")[0]
+            try:
+                handle = handles.get(name)
+                if handle is None:
+                    handle = get_deployment_handle(name)
+                    handles[name] = handle
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"null")
+                result = handle.remote(body).result(timeout=30.0)
+                payload = json.dumps({"result": result},
+                                     default=str).encode()
+                self.send_response(200)
+            except Exception as e:
+                payload = json.dumps({"error": str(e)}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    _http_server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=_http_server.serve_forever,
+                     daemon=True).start()
+    return f"http://{host}:{_http_server.server_address[1]}"

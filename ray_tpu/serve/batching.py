@@ -1,0 +1,91 @@
+"""@serve.batch — dynamic request batching inside a replica.
+
+Reference: ``python/ray/serve/batching.py`` (``@serve.batch`` queues
+concurrent calls, fires the underlying function once per batch).
+Implementation: a per-function collector thread gathers requests until
+``max_batch_size`` or ``batch_wait_timeout_s`` and invokes the wrapped
+callable with the list; callers block on their slot's future. Works with
+threaded actors (``max_concurrency > 1``) — concurrency is what creates
+batchable simultaneous requests.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue as _queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.q: "_queue.Queue" = _queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self.q.get()          # (arg, future)
+            batch = [item]
+            deadline = self.timeout_s
+            while len(batch) < self.max_batch_size:
+                try:
+                    batch.append(self.q.get(timeout=deadline))
+                except _queue.Empty:
+                    break
+            args = [a for a, _ in batch]
+            futures = [f for _, f in batch]
+            try:
+                results = self.fn(args)
+                if results is None or len(results) != len(args):
+                    raise ValueError(
+                        "@serve.batch function must return one result per "
+                        f"input ({len(args)} inputs)")
+                for fut, res in zip(futures, results):
+                    fut.set_result(res)
+            except Exception as e:
+                for fut in futures:
+                    fut.set_exception(e)
+
+    def submit(self, arg: Any) -> Any:
+        self._ensure_thread()
+        fut: Future = Future()
+        self.q.put((arg, fut))
+        return fut.result()
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate a method taking a LIST of requests; singular calls are
+    coalesced into batches transparently."""
+
+    def decorator(fn):
+        attr = f"__rtpu_batcher_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(self, request):
+            b = getattr(self, attr, None)
+            if b is None:
+                b = _Batcher(lambda args: fn(self, args), max_batch_size,
+                             batch_wait_timeout_s)
+                setattr(self, attr, b)
+            return b.submit(request)
+
+        wrapper._rtpu_is_batched = True
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

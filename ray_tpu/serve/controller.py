@@ -1,0 +1,141 @@
+"""ServeController — declarative state reconciliation + autoscaling.
+
+Reference: ``serve/controller.py:80`` (ServeController actor),
+``_private/deployment_state.py:2258`` (DeploymentStateManager.update —
+diff target vs actual, start/stop replicas), ``_private/
+autoscaling_policy.py`` (queue-depth driven replica counts). One
+controller actor owns all deployment state; handles poll it for replica
+lists (the reference pushes via long-poll — polling with a TTL is the
+same contract with simpler liveness).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..api import remote
+
+
+@remote(num_cpus=0, max_concurrency=8)
+class ServeController:
+    def __init__(self):
+        # name -> {"deployment": Deployment, "replicas": [handles],
+        #          "target": int}
+        self._deployments: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop, daemon=True)
+        self._autoscale_thread.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def deploy(self, name: str, deployment_blob: bytes,
+               init_args: tuple, init_kwargs: dict,
+               num_replicas: int, ray_actor_options: dict,
+               autoscaling_config: Optional[dict],
+               max_concurrency: int) -> None:
+        from .._private import serialization as ser
+        cls = ser.loads_function(deployment_blob)
+        with self._lock:
+            rec = self._deployments.get(name)
+            if rec is None:
+                rec = {"replicas": [], "target": 0}
+                self._deployments[name] = rec
+            rec.update(
+                cls_blob=deployment_blob, cls=cls,
+                init_args=init_args, init_kwargs=init_kwargs,
+                actor_options=ray_actor_options or {},
+                autoscaling=autoscaling_config,
+                max_concurrency=max_concurrency,
+                target=num_replicas)
+        self._reconcile(name)
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            rec = self._deployments.pop(name, None)
+        if rec:
+            self._stop_replicas(rec["replicas"])
+
+    def shutdown(self) -> None:
+        with self._lock:
+            names = list(self._deployments)
+        for n in names:
+            self.delete(n)
+
+    # ---------------------------------------------------------- introspection
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            rec = self._deployments.get(name)
+            return list(rec["replicas"]) if rec else []
+
+    def list_deployments(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: len(r["replicas"])
+                    for n, r in self._deployments.items()}
+
+    # ------------------------------------------------------------- internals
+    def _reconcile(self, name: str) -> None:
+        from . import replica as rep
+        with self._lock:
+            rec = self._deployments.get(name)
+            if rec is None:
+                return
+            want = rec["target"]
+            have = len(rec["replicas"])
+            cls_blob = rec["cls_blob"]
+            args, kwargs = rec["init_args"], rec["init_kwargs"]
+            opts = dict(rec["actor_options"])
+            opts.setdefault("max_concurrency", rec["max_concurrency"])
+        while have < want:
+            replica = rep.Replica.options(**opts).remote(
+                cls_blob, args, kwargs)
+            with self._lock:
+                rec["replicas"].append(replica)
+            have += 1
+        excess = []
+        with self._lock:
+            while len(rec["replicas"]) > want:
+                excess.append(rec["replicas"].pop())
+        self._stop_replicas(excess)
+
+    def _stop_replicas(self, replicas: List[Any]) -> None:
+        from .. import kill
+        for r in replicas:
+            try:
+                kill(r)
+            except Exception:
+                pass
+
+    def _autoscale_loop(self) -> None:
+        from .. import get
+        while True:
+            time.sleep(0.25)
+            with self._lock:
+                items = [(n, rec) for n, rec in self._deployments.items()
+                         if rec.get("autoscaling")]
+            for name, rec in items:
+                try:
+                    cfg = rec["autoscaling"]
+                    with self._lock:
+                        replicas = list(rec["replicas"])
+                    if not replicas:
+                        continue
+                    depths = get([r.queue_depth.remote()
+                                  for r in replicas], timeout=2.0)
+                    avg = sum(depths) / len(depths)
+                    target_per = cfg.get(
+                        "target_num_ongoing_requests_per_replica", 2)
+                    want = len(replicas)
+                    if avg > target_per:
+                        want += 1
+                    elif avg < target_per / 2 and want > 1:
+                        want -= 1
+                    want = max(cfg.get("min_replicas", 1),
+                               min(cfg.get("max_replicas", 4), want))
+                    if want != len(replicas):
+                        with self._lock:
+                            rec["target"] = want
+                        self._reconcile(name)
+                except Exception:
+                    continue

@@ -1,0 +1,106 @@
+"""DeploymentHandle — client-side router with power-of-two-choices.
+
+Reference: ``serve/_private/router.py:944`` (Router) + ``:330``
+(PowerOfTwoChoicesReplicaScheduler): pick two random replicas, send to
+the one with the shorter queue. Queue lengths here are tracked
+client-side per handle (in-flight counter per replica), refreshed with
+the controller's replica list on a TTL.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import get
+
+_REFRESH_S = 1.0
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+
+    # -------------------------------------------------------------- routing
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_refresh < _REFRESH_S:
+            return
+        replicas = get(self._controller.get_replicas.remote(
+            self.deployment_name))
+        with self._lock:
+            self._replicas = replicas
+            self._inflight = {i: self._inflight.get(i, 0)
+                              for i in range(len(replicas))}
+            self._last_refresh = now
+
+    def _pick(self) -> int:
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas")
+            if n == 1:
+                idx = 0
+            else:
+                a, b = self._rng.sample(range(n), 2)
+                idx = a if self._inflight.get(a, 0) <= \
+                    self._inflight.get(b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            return idx
+
+    def _done(self, idx: int) -> None:
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+    # ---------------------------------------------------------------- calls
+    def remote(self, *args, **kwargs):
+        """Route one request; returns an ObjectRef."""
+        self._refresh()
+        for attempt in range(3):
+            idx = self._pick()
+            with self._lock:
+                replica = self._replicas[idx]
+            try:
+                ref = replica.handle_request.remote(*args, **kwargs)
+            except Exception:
+                self._done(idx)
+                self._refresh(force=True)
+                continue
+            # in-flight slot released when the response is consumed
+            return _TrackedRef(ref, self, idx)
+        raise RuntimeError("no live replica accepted the request")
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self._controller))
+
+
+class _TrackedRef:
+    """ObjectRef wrapper that releases the in-flight slot on result()."""
+
+    def __init__(self, ref, handle: DeploymentHandle, idx: int):
+        self._ref = ref
+        self._handle = handle
+        self._idx = idx
+        self._resolved = False
+
+    @property
+    def ref(self):
+        return self._ref
+
+    def result(self, timeout: Optional[float] = None):
+        try:
+            return get(self._ref, timeout=timeout)
+        finally:
+            if not self._resolved:
+                self._resolved = True
+                self._handle._done(self._idx)

@@ -1,0 +1,54 @@
+"""Replica actor wrapping the user callable.
+
+Reference: ``serve/_private/replica.py:494`` (RayServeReplica.
+handle_request → user callable, queue metrics for autoscaling).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..api import remote
+
+
+@remote(max_concurrency=8)
+class Replica:
+    def __init__(self, cls_blob: bytes, init_args: tuple,
+                 init_kwargs: dict):
+        from .._private import serialization as ser
+        target = ser.loads_function(cls_blob)
+        if isinstance(target, type):
+            self._instance = target(*init_args, **init_kwargs)
+        else:
+            self._instance = target          # plain function deployment
+        self._depth = 0
+        self._depth_lock = threading.Lock()
+
+    def handle_request(self, *args, **kwargs):
+        with self._depth_lock:
+            self._depth += 1
+        try:
+            if not callable(self._instance):
+                raise TypeError("deployment target is not callable")
+            return self._instance(*args, **kwargs)
+        finally:
+            with self._depth_lock:
+                self._depth -= 1
+
+    def call_method(self, method_name: str, *args, **kwargs):
+        with self._depth_lock:
+            self._depth += 1
+        try:
+            return getattr(self._instance, method_name)(*args, **kwargs)
+        finally:
+            with self._depth_lock:
+                self._depth -= 1
+
+    def queue_depth(self) -> int:
+        # executing + queued requests on this replica (approximation of
+        # the reference's num_ongoing_requests metric)
+        return self._depth
+
+    def reconfigure(self, user_config) -> None:
+        if hasattr(self._instance, "reconfigure"):
+            self._instance.reconfigure(user_config)

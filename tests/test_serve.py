@@ -1,0 +1,115 @@
+"""Serve tests (reference model: ``python/ray/serve/tests/`` — deploy,
+handle routing, batching, autoscaling, HTTP)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_session(rtpu_init):
+    yield
+    serve.shutdown()
+
+
+def test_function_deployment(serve_session):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind())
+    assert handle.remote(7).result(timeout=10) == 49
+
+
+def test_class_deployment_and_replicas(serve_session):
+    @serve.deployment(num_replicas=2)
+    class Adder:
+        def __init__(self, bias):
+            self.bias = bias
+
+        def __call__(self, x):
+            return x + self.bias
+
+    handle = serve.run(Adder.bind(10))
+    results = [handle.remote(i).result(timeout=10) for i in range(6)]
+    assert results == [10, 11, 12, 13, 14, 15]
+    controller = ray_tpu.get_actor("rtpu:serve_controller")
+    counts = ray_tpu.get(controller.list_deployments.remote())
+    assert counts["Adder"] == 2
+
+
+def test_batching(serve_session):
+    @serve.deployment(max_concurrent_queries=8)
+    class Model:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.1)
+        def _infer(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        def __call__(self, x):
+            return self._infer(x)
+
+        def seen_batches(self):
+            return self.batch_sizes
+
+    handle = serve.run(Model.bind())
+    # concurrent requests coalesce into batches
+    responses = [handle.remote(i) for i in range(8)]
+    values = sorted(r.result(timeout=15) for r in responses)
+    assert values == [0, 2, 4, 6, 8, 10, 12, 14]
+    controller = ray_tpu.get_actor("rtpu:serve_controller")
+    replicas = ray_tpu.get(
+        controller.get_replicas.remote("Model"))
+    sizes = ray_tpu.get(
+        replicas[0].call_method.remote("seen_batches"))
+    assert max(sizes) > 1          # at least one real batch formed
+
+
+def test_http_gateway(serve_session):
+    @serve.deployment
+    def echo(body):
+        return {"echo": body}
+
+    serve.run(echo.bind())
+    url = serve.start_http(port=0)
+    req = urllib.request.Request(
+        f"{url}/echo", data=json.dumps({"hi": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        payload = json.loads(resp.read())
+    assert payload["result"]["echo"] == {"hi": 1}
+
+
+def test_autoscaling_up(serve_session):
+    @serve.deployment(num_replicas=1,
+                      autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 3,
+                                          "target_num_ongoing_requests_per_replica": 1})
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Slow.bind())
+    responses = [handle.remote(i) for i in range(12)]
+    deadline = time.monotonic() + 15
+    controller = ray_tpu.get_actor("rtpu:serve_controller")
+    scaled = False
+    while time.monotonic() < deadline:
+        counts = ray_tpu.get(controller.list_deployments.remote())
+        if counts.get("Slow", 1) > 1:
+            scaled = True
+            break
+        time.sleep(0.2)
+    for r in responses:
+        r.result(timeout=30)
+    assert scaled, "autoscaler never added a replica under load"
