@@ -1,0 +1,22 @@
+"""ray_tpu.rl — reinforcement learning at scale (the RLlib equivalent).
+
+Reference: RLlib (``rllib/``, SURVEY §2.3/§3.6) new stack: `Algorithm`
+owns rollout workers (env sampling actors) and a `LearnerGroup` of
+learner actors for SGD. TPU-native mapping:
+
+  * RolloutWorker actors run envs on CPU hosts and evaluate the policy
+    with jitted JAX on host devices — sampling never touches the TPU.
+  * The Learner's update is ONE jitted SPMD program (loss + grad + optax)
+    over a device mesh; multi-learner data-parallelism is mesh `dp`, not
+    NCCL DDP (reference wraps ``TorchLearner`` in DDP,
+    ``core/learner/torch/torch_learner.py:378``).
+  * Weights move learner→workers through the shm object store.
+
+Built-in envs avoid a gym dependency (CartPole dynamics are 20 lines).
+"""
+
+from .env import CartPoleEnv, RandomEnv  # noqa: F401
+from .learner import Learner, LearnerGroup  # noqa: F401
+from .module import DiscretePolicyModule  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401
+from .sample_batch import SampleBatch, concat_batches  # noqa: F401

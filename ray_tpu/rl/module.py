@@ -1,0 +1,72 @@
+"""RLModule: the policy/value network in functional JAX.
+
+Reference: ``rllib/core/rl_module/rl_module.py:229`` (+ the minimal JAX
+FCNet the reference already sketches at ``rllib/models/jax/fcnet.py``).
+One module = params pytree + pure apply functions; the same params run
+jitted on TPU (learner) and on CPU (rollout workers).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _dense_init(key, n_in, n_out, scale):
+    w_key, _ = jax.random.split(key)
+    # orthogonal init (PPO standard)
+    a = jax.random.normal(w_key, (n_in, n_out))
+    q, r = jnp.linalg.qr(a)
+    q = q * jnp.sign(jnp.diag(r))[None, :]
+    if q.shape != (n_in, n_out):
+        q = jnp.resize(q, (n_in, n_out))
+    return {"w": (q * scale).astype(jnp.float32),
+            "b": jnp.zeros((n_out,), jnp.float32)}
+
+
+class DiscretePolicyModule:
+    """MLP torso + categorical policy head + value head."""
+
+    def __init__(self, observation_size: int, action_size: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.observation_size = observation_size
+        self.action_size = action_size
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        keys = jax.random.split(rng, len(self.hidden) + 2)
+        params: Dict[str, Any] = {"torso": []}
+        n_in = self.observation_size
+        for i, h in enumerate(self.hidden):
+            params["torso"].append(_dense_init(keys[i], n_in, h,
+                                               math.sqrt(2.0)))
+            n_in = h
+        params["pi"] = _dense_init(keys[-2], n_in, self.action_size, 0.01)
+        params["vf"] = _dense_init(keys[-1], n_in, 1, 1.0)
+        return params
+
+    def _torso(self, params, obs):
+        x = obs
+        for layer in params["torso"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        return x
+
+    def forward(self, params, obs) -> Tuple[jax.Array, jax.Array]:
+        """obs [B, obs_size] → (logits [B, A], value [B])."""
+        x = self._torso(params, obs)
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    def action_dist(self, params, obs, rng) -> Tuple[jax.Array, jax.Array,
+                                                     jax.Array]:
+        """Sample actions: (action, logp, value)."""
+        logits, value = self.forward(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action]
+        return action, logp, value

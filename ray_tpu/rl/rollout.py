@@ -1,0 +1,89 @@
+"""RolloutWorker: env-sampling actor.
+
+Reference: ``rllib/evaluation/rollout_worker.py:159`` + SyncSampler
+``evaluation/sampler.py:144``. Policy evaluation is jitted JAX on the
+worker's host devices; env stepping is plain python — the hot loop the
+reference also runs in python workers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..api import remote
+from . import sample_batch as SB
+from .module import DiscretePolicyModule
+from .sample_batch import SampleBatch, compute_gae
+
+
+@remote
+class RolloutWorker:
+    def __init__(self, env_creator: Callable, module_config: dict,
+                 *, gamma: float = 0.99, lam: float = 0.95,
+                 seed: int = 0):
+        import jax
+        self.env = env_creator()
+        self.module = DiscretePolicyModule(**module_config)
+        self.gamma = gamma
+        self.lam = lam
+        self._rng = jax.random.PRNGKey(seed)
+        self._act = jax.jit(self.module.action_dist)
+        self._value = jax.jit(
+            lambda p, o: self.module.forward(p, o)[1])
+        self._obs: Optional[np.ndarray] = None
+        self._episode_reward = 0.0
+        self._episode_rewards = []
+
+    def sample(self, weights, num_steps: int) -> Tuple[dict, dict]:
+        """Collect num_steps transitions (episodes continue across
+        calls); returns (SampleBatch dict with GAE, stats)."""
+        import jax
+        params = jax.tree_util.tree_map(jax.numpy.asarray, weights)
+        if self._obs is None:
+            self._obs, _ = self.env.reset()
+            self._episode_reward = 0.0
+        obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+        logp_buf, vf_buf = [], []
+        for _ in range(num_steps):
+            self._rng, key = jax.random.split(self._rng)
+            action, logp, value = self._act(
+                params, self._obs[None, :], key)
+            a = int(action[0])
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            obs_buf.append(self._obs)
+            act_buf.append(a)
+            rew_buf.append(reward)
+            done_buf.append(terminated)
+            logp_buf.append(float(logp[0]))
+            vf_buf.append(float(value[0]))
+            self._episode_reward += reward
+            if terminated or truncated:
+                self._episode_rewards.append(self._episode_reward)
+                self._obs, _ = self.env.reset()
+                self._episode_reward = 0.0
+            else:
+                self._obs = next_obs
+        # bootstrap value for the unfinished tail
+        last_value = 0.0
+        if not (done_buf and done_buf[-1]):
+            last_value = float(self._value(params,
+                                           self._obs[None, :])[0])
+        batch = SampleBatch({
+            SB.OBS: np.asarray(obs_buf, np.float32),
+            SB.ACTIONS: np.asarray(act_buf, np.int32),
+            SB.REWARDS: np.asarray(rew_buf, np.float32),
+            SB.DONES: np.asarray(done_buf, bool),
+            SB.LOGP: np.asarray(logp_buf, np.float32),
+            SB.VF_PREDS: np.asarray(vf_buf, np.float32),
+        })
+        batch = compute_gae(batch, gamma=self.gamma, lam=self.lam,
+                            last_value=last_value)
+        recent = self._episode_rewards[-20:]
+        stats = {
+            "episodes_total": len(self._episode_rewards),
+            "episode_reward_mean": (float(np.mean(recent))
+                                    if recent else float("nan")),
+        }
+        return dict(batch), stats
