@@ -127,7 +127,7 @@ class CoreClient:
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
         meta = self._store_value(oid, value)
-        if meta.shm_name is not None:
+        if meta.shm_name is not None or meta.arena_ref is not None:
             # Large object: block until the node store adopts it, so the
             # store's budget accounting (and spilling) stays ahead of the
             # writer — matches the reference, where ``ray.put`` returns only
@@ -139,28 +139,51 @@ class CoreClient:
 
     def _sync_put(self, meta: ObjectMeta) -> None:
         """Acked put of a shm-backed object; unlinks the segment if the
-        node rejects it, since no store owns it then."""
+        node rejects it, since no store owns it then. (Arena-backed
+        objects need no cleanup here: the allocation is owned by the
+        node store from the Create.)"""
         try:
             self._request(P.PUT_OBJECT_SYNC,
                           lambda rid: (rid, meta)).result()
         except BaseException:
-            from multiprocessing import shared_memory
-            try:
-                seg = shared_memory.SharedMemory(name=meta.shm_name)
-                seg.close()
-                seg.unlink()
-            except Exception:  # noqa: BLE001 — best-effort cleanup
-                pass
+            if meta.shm_name is not None:
+                from multiprocessing import shared_memory
+                try:
+                    seg = shared_memory.SharedMemory(name=meta.shm_name)
+                    seg.close()
+                    seg.unlink()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
             raise
 
     def _store_value(self, oid: ObjectID, value: Any) -> ObjectMeta:
-        """Serialize a value; small inline, large into a fresh shm segment."""
+        """Serialize a value; small inline, large into shm."""
         smeta, views = ser.serialize(value)
         total = ser.serialized_size(smeta, views)
         if total <= CONFIG.max_inline_object_bytes:
             out = bytearray(total)
             ser.write_to(memoryview(out), smeta, views)
             return ObjectMeta(object_id=oid, size=total, inline=bytes(out))
+        return self.store_large(oid, smeta, views, total)
+
+    def store_large(self, oid: ObjectID, smeta, views,
+                    total: int) -> ObjectMeta:
+        """Write a large payload into shm: arena Create/Seal when the
+        node store offers an arena slot (one mmap per process,
+        ``native/object_arena.cpp``), else a dedicated segment."""
+        from . import native
+        if CONFIG.use_native_arena and native.available():
+            try:
+                ref = self._request(P.ALLOC_OBJECT,
+                                    lambda rid: (rid, oid, total)).result()
+            except Exception:
+                ref = None
+            if ref is not None:
+                path, off = ref
+                reader = native.ArenaReader.get(path)
+                ser.write_to(reader.buffer(off, total), smeta, views)
+                return ObjectMeta(object_id=oid, size=total,
+                                  arena_ref=(path, off))
         seg = create_segment(oid, total)
         ser.write_to(seg.buf, smeta, views)
         name = seg.name
@@ -257,11 +280,7 @@ class CoreClient:
         # the same reason as put(): the store's budget accounting must not
         # lag behind a writer looping over f.remote(big_array).
         oid = ObjectID.for_put(self.worker_id)
-        seg = create_segment(oid, total)
-        ser.write_to(seg.buf, smeta, views)
-        name = seg.name
-        seg.close()
-        meta = ObjectMeta(object_id=oid, size=total, shm_name=name)
+        meta = self.store_large(oid, smeta, views, total)
         self._sync_put(meta)
         return ("r", oid)
 

@@ -26,6 +26,10 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                   "fraction of store memory above which primary "
                                   "copies are spilled to disk"),
     "spill_directory": (str, "", "directory for spilled objects (default: session dir)"),
+    "use_native_arena": (bool, True,
+                         "allocate store objects from the C++ shm arena "
+                         "(native/object_arena.cpp) when the library builds; "
+                         "falls back to per-object segments"),
     # --- scheduler ---
     "scheduler_spread_threshold": (float, 0.5,
                                    "hybrid policy: pack below this node utilization, "

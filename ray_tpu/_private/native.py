@@ -1,0 +1,161 @@
+"""ctypes bindings for the native runtime (``native/*.cpp``).
+
+The shared library is built on demand with make (g++ is in the image;
+pybind11 is not, so the ABI is plain C via ctypes). Everything degrades
+gracefully: if the toolchain or build is unavailable, callers fall back
+to pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_native", "libobject_arena.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB_PATH):
+            if not os.path.isdir(_NATIVE_DIR):
+                _build_failed = True
+                return None
+            try:
+                # inter-process flock: many workers may race the first
+                # build; exactly one runs make, the rest wait on the lock
+                import fcntl
+                lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+                with open(lock_path, "w") as lock_f:
+                    fcntl.flock(lock_f, fcntl.LOCK_EX)
+                    if not os.path.exists(_LIB_PATH):
+                        subprocess.run(["make", "-s"], cwd=_NATIVE_DIR,
+                                       check=True, capture_output=True,
+                                       timeout=120)
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.arena_create.restype = ctypes.c_void_p
+        lib.arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.arena_attach.restype = ctypes.c_void_p
+        lib.arena_attach.argtypes = [ctypes.c_char_p]
+        lib.arena_alloc.restype = ctypes.c_int64
+        lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.arena_free.restype = ctypes.c_int
+        lib.arena_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.arena_base.restype = ctypes.POINTER(ctypes.c_ubyte)
+        lib.arena_base.argtypes = [ctypes.c_void_p]
+        lib.arena_capacity.restype = ctypes.c_uint64
+        lib.arena_capacity.argtypes = [ctypes.c_void_p]
+        lib.arena_used.restype = ctypes.c_uint64
+        lib.arena_used.argtypes = [ctypes.c_void_p]
+        lib.arena_num_blocks.restype = ctypes.c_uint64
+        lib.arena_num_blocks.argtypes = [ctypes.c_void_p]
+        lib.arena_close.restype = None
+        lib.arena_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class Arena:
+    """Owner-side arena (the node store process allocates; readers use
+    ``ArenaReader``)."""
+
+    def __init__(self, path: str, capacity: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native arena unavailable")
+        self._lib = lib
+        self.path = path
+        self._handle = lib.arena_create(path.encode(), capacity)
+        if not self._handle:
+            raise RuntimeError(f"arena_create failed for {path}")
+        self.capacity = lib.arena_capacity(self._handle)
+
+    def alloc(self, size: int) -> Optional[int]:
+        off = self._lib.arena_alloc(self._handle, size)
+        return None if off < 0 else off
+
+    def free(self, offset: int) -> None:
+        self._lib.arena_free(self._handle, offset)
+
+    def view(self, offset: int, size: int) -> memoryview:
+        base = self._lib.arena_base(self._handle)
+        addr = ctypes.addressof(base.contents) + offset
+        return (ctypes.c_ubyte * size).from_address(addr)
+
+    def buffer(self, offset: int, size: int) -> memoryview:
+        return memoryview(self.view(offset, size)).cast("B")
+
+    @property
+    def used(self) -> int:
+        return self._lib.arena_used(self._handle)
+
+    @property
+    def num_blocks(self) -> int:
+        return self._lib.arena_num_blocks(self._handle)
+
+    def close(self, unlink: bool = True) -> None:
+        if self._handle:
+            self._lib.arena_close(self._handle, 1 if unlink else 0)
+            self._handle = None
+
+
+class ArenaReader:
+    """Reader-side attachment (one mmap per process per arena)."""
+
+    _cache: dict = {}
+    _cache_lock = threading.Lock()
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native arena unavailable")
+        self._lib = lib
+        self._handle = lib.arena_attach(path.encode())
+        if not self._handle:
+            raise RuntimeError(f"arena_attach failed for {path}")
+
+    @classmethod
+    def get(cls, path: str) -> "ArenaReader":
+        with cls._cache_lock:
+            reader = cls._cache.get(path)
+            if reader is None:
+                reader = cls(path)
+                cls._cache[path] = reader
+            return reader
+
+    def buffer(self, offset: int, size: int) -> memoryview:
+        base = self._lib.arena_base(self._handle)
+        addr = ctypes.addressof(base.contents) + offset
+        return memoryview((ctypes.c_ubyte * size).from_address(addr)) \
+            .cast("B")
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.arena_close(self._handle, 0)
+            self._handle = None

@@ -322,6 +322,13 @@ class NodeService:
             self._submit_actor_task(payload)
         elif op == P.PUT_OBJECT:
             self._seal_object(payload)
+        elif op == P.ALLOC_OBJECT:
+            req_id, oid, size = payload
+            try:
+                ref = self.store.alloc_in_arena(oid, size, writer_tag=key)
+            except Exception:   # noqa: BLE001 — client blocks on a reply
+                ref = None
+            self._reply(key, P.INFO_REPLY, (req_id, ref))
         elif op == P.PUT_OBJECT_SYNC:
             req_id, meta = payload
             try:
@@ -519,7 +526,7 @@ class NodeService:
             return None
         nid, meta = loc
         if (meta.shm_name is None and meta.inline is None
-                and meta.error is None):
+                and meta.error is None and meta.arena_ref is None):
             # The owning node spilled it (spilling blanks shm_name on the
             # directory-shared meta); restore through that node's store —
             # reference analogue: RestoreSpilledObjects via the primary
@@ -1139,6 +1146,8 @@ class NodeService:
     def _on_conn_closed(self, key: int) -> None:
         self._conns.pop(key, None)
         self._driver_conn_keys.discard(key)
+        # arena Creates this connection never sealed are garbage now
+        self.store.reclaim_unsealed(key)
         wid = self._conn_worker.pop(key, None)
         if wid is None:
             return

@@ -81,9 +81,16 @@ class ObjectMeta:
     shm_name: Optional[str] = None  # segment name, for large objects
     error: Optional[bytes] = None   # pickled exception, for failed tasks
     node_hint: Optional[bytes] = None  # NodeID binary of a known location
+    # (arena_path, payload_offset): object lives in the node's C++ shm
+    # arena (plasma-style Create/Seal; ``native/object_arena.cpp``)
+    arena_ref: Optional[tuple] = None
 
     def is_error(self) -> bool:
         return self.error is not None
+
+    def has_value(self) -> bool:
+        return (self.inline is not None or self.shm_name is not None
+                or self.arena_ref is not None or self.error is not None)
 
 
 @dataclass
@@ -95,6 +102,13 @@ class _Entry:
     spilled_path: Optional[str] = None
     last_used: float = field(default_factory=time.monotonic)
     charged: bool = False  # whether meta.size is counted in store._used
+    # meta has been handed to a reader: arena-backed entries then become
+    # unspillable — a reader may hold zero-copy views into the arena, and
+    # unlike POSIX segments (kernel refcount keeps pages alive) a freed
+    # arena block gets reused, which would silently corrupt those views
+    ever_read: bool = False
+    # connection that holds an unsealed Create; its death reclaims it
+    writer_tag: Optional[int] = None
 
 
 class ObjectStore:
@@ -103,6 +117,10 @@ class ObjectStore:
     Thread-safe; used from the node service event loop and (for driver-side
     fast-path puts) the driver thread.
     """
+
+    # arena-eligible payload range: below -> inline, above -> dedicated
+    # segment (huge objects would fragment the arena)
+    ARENA_MAX_OBJECT = 64 << 20
 
     def __init__(self, capacity_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None):
@@ -113,6 +131,22 @@ class ObjectStore:
         self._spill_dir = spill_dir or CONFIG.spill_directory or "/tmp/rtpu_spill"
         self.num_spilled = 0
         self.num_restored = 0
+        # C++ shm arena (plasma-equivalent allocator). One mapping per
+        # node; all readers attach once. Optional: pure-python segments
+        # remain the fallback and the path for huge objects.
+        self._arena = None
+        if CONFIG.use_native_arena:
+            try:
+                from . import native
+                if native.available():
+                    # random suffix: pid+id can repeat across store
+                    # restarts in one process, and reader processes cache
+                    # mappings by path
+                    suffix = os.urandom(8).hex()
+                    path = f"/dev/shm/rtpu_arena_{suffix}"
+                    self._arena = native.Arena(path, self._capacity)
+            except Exception:
+                self._arena = None
 
     # ------------------------------------------------------------------ put
     def put_inline(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
@@ -150,13 +184,72 @@ class ObjectStore:
             self._entries[object_id] = _Entry(meta=meta, sealed=True)
         return meta
 
+    def alloc_in_arena(self, object_id: ObjectID, size: int,
+                       writer_tag: Optional[int] = None) -> Optional[tuple]:
+        """Plasma-style Create: reserve arena space for a writer in
+        another process. Returns (arena_path, offset) or None (no arena /
+        full / out of the arena size class). The entry exists unsealed
+        until the writer's seal (adopt) lands; ``writer_tag`` (the
+        writer's connection key) lets ``reclaim_unsealed`` free the block
+        if the writer dies before sealing."""
+        if self._arena is None or size > self.ARENA_MAX_OBJECT:
+            return None
+        with self._lock:
+            if object_id in self._entries:
+                return None
+            self._ensure_capacity(size)
+            off = self._arena.alloc(size)
+            if off is None:
+                return None
+            meta = ObjectMeta(object_id=object_id, size=size,
+                              arena_ref=(self._arena.path, off))
+            self._entries[object_id] = _Entry(meta=meta, charged=True,
+                                              writer_tag=writer_tag)
+            self._used += size
+            return (self._arena.path, off)
+
+    def reclaim_unsealed(self, writer_tag: int) -> None:
+        """Free arena Creates whose writer connection died pre-seal."""
+        with self._lock:
+            dead = [oid for oid, e in self._entries.items()
+                    if not e.sealed and e.writer_tag == writer_tag]
+            for oid in dead:
+                e = self._entries.pop(oid)
+                if e.charged:
+                    self._used -= e.meta.size
+                if (e.meta.arena_ref is not None and self._arena is not None
+                        and e.meta.arena_ref[0] == self._arena.path):
+                    self._arena.free(e.meta.arena_ref[1])
+
     def adopt(self, meta: ObjectMeta) -> None:
         """Record an object whose segment was created by another process
         (a worker sealing a large task return). This is the main write path,
-        so the store budget is enforced here."""
+        so the store budget is enforced here. For arena-backed objects this
+        is the Seal half of Create/Seal: the entry exists from
+        ``alloc_in_arena`` and budget is already charged."""
         with self._lock:
-            if meta.object_id in self._entries:
-                return
+            existing = self._entries.get(meta.object_id)
+            if existing is not None:
+                if not existing.sealed and meta.arena_ref is not None \
+                        and existing.meta.arena_ref == meta.arena_ref:
+                    existing.sealed = True
+                    existing.writer_tag = None
+                    existing.last_used = time.monotonic()
+                    return
+                if not existing.sealed:
+                    # a retried writer fell back to a different home
+                    # (e.g. segment after its predecessor's orphaned
+                    # Create): reclaim the stale allocation, adopt fresh
+                    self._entries.pop(meta.object_id)
+                    if existing.charged:
+                        self._used -= existing.meta.size
+                    if (existing.meta.arena_ref is not None
+                            and self._arena is not None
+                            and existing.meta.arena_ref[0]
+                            == self._arena.path):
+                        self._arena.free(existing.meta.arena_ref[1])
+                else:
+                    return
             charged = bool(meta.shm_name or meta.inline)
             if charged:
                 self._ensure_capacity(meta.size)
@@ -171,11 +264,13 @@ class ObjectStore:
             return e is not None and e.sealed
 
     def _touch(self, object_id: ObjectID) -> Optional[_Entry]:
-        """Lookup + LRU touch + restore-if-spilled; callers hold _lock."""
+        """Lookup + LRU touch + restore-if-spilled; callers hold _lock.
+        Handing out a meta marks the entry read (see _Entry.ever_read)."""
         e = self._entries.get(object_id)
         if e is None or not e.sealed:
             return None
         e.last_used = time.monotonic()
+        e.ever_read = True
         self._entries.move_to_end(object_id)
         if e.spilled_path is not None:
             self._restore(object_id, e)
@@ -219,7 +314,13 @@ class ObjectStore:
                     continue
                 if e.charged:
                     self._used -= e.meta.size
-                if e.segment is not None:
+                if e.meta.arena_ref is not None:
+                    # only the owning arena frees; adopted copies of
+                    # another node's arena object are metadata-only
+                    if (self._arena is not None
+                            and e.meta.arena_ref[0] == self._arena.path):
+                        self._arena.free(e.meta.arena_ref[1])
+                elif e.segment is not None:
                     try:
                         e.segment.close()
                         e.segment.unlink()
@@ -242,13 +343,18 @@ class ObjectStore:
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            return {
+            out = {
                 "num_objects": len(self._entries),
                 "used_bytes": self._used,
                 "capacity_bytes": self._capacity,
                 "num_spilled": self.num_spilled,
                 "num_restored": self.num_restored,
+                "arena_enabled": int(self._arena is not None),
             }
+            if self._arena is not None:
+                out["arena_used_bytes"] = self._arena.used
+                out["arena_num_blocks"] = self._arena.num_blocks
+            return out
 
     # ------------------------------------------------------- spill/restore
     def _ensure_capacity(self, incoming: int) -> None:
@@ -259,44 +365,69 @@ class ObjectStore:
             if self._used + incoming <= threshold:
                 break
             e = self._entries[oid]
-            if (e.sealed and e.pinned == 0 and e.meta.shm_name is not None
-                    and e.spilled_path is None):
+            if not (e.sealed and e.pinned == 0 and e.spilled_path is None
+                    and e.charged):
+                continue
+            if e.meta.shm_name is not None:
+                self._spill(oid, e)
+            elif e.meta.arena_ref is not None and not e.ever_read:
+                # read arena entries never spill: readers may hold
+                # zero-copy views and arena blocks are reused after free
+                # (segments are safe — the kernel refcounts attachments)
                 self._spill(oid, e)
 
     def _spill(self, object_id: ObjectID, e: _Entry) -> None:
         os.makedirs(self._spill_dir, exist_ok=True)
         path = os.path.join(self._spill_dir, _segment_name(object_id))
-        seg = e.segment
-        if seg is None:
-            # adopted segment: created by a worker/driver, attach by name
-            try:
-                seg = attach_segment(e.meta.shm_name)
-            except FileNotFoundError:
+        if e.meta.arena_ref is not None:
+            if self._arena is None:
                 return
-        with open(path, "wb") as f:
-            f.write(seg.buf[:e.meta.size])
+            off = e.meta.arena_ref[1]
+            with open(path, "wb") as f:
+                f.write(self._arena.buffer(off, e.meta.size))
+            self._arena.free(off)
+            e.meta.arena_ref = None
+        else:
+            seg = e.segment
+            if seg is None:
+                # adopted segment: created by a worker/driver, attach by name
+                try:
+                    seg = attach_segment(e.meta.shm_name)
+                except FileNotFoundError:
+                    return
+            with open(path, "wb") as f:
+                f.write(seg.buf[:e.meta.size])
+            seg.close()
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+            e.segment = None
+            e.meta.shm_name = None
         e.spilled_path = path
-        seg.close()
-        try:
-            seg.unlink()
-        except FileNotFoundError:
-            pass
-        e.segment = None
-        e.meta.shm_name = None
         self._used -= e.meta.size
         e.charged = False
         self.num_spilled += 1
 
     def _restore(self, object_id: ObjectID, e: _Entry) -> None:
         self._ensure_capacity(e.meta.size)
-        seg = shared_memory.SharedMemory(
-            create=True, size=max(e.meta.size, 1), name=_segment_name(object_id))
-        with open(e.spilled_path, "rb") as f:
-            f.readinto(seg.buf[:e.meta.size])
+        off = (self._arena.alloc(e.meta.size)
+               if (self._arena is not None
+                   and e.meta.size <= self.ARENA_MAX_OBJECT) else None)
+        if off is not None:
+            with open(e.spilled_path, "rb") as f:
+                f.readinto(self._arena.buffer(off, e.meta.size))
+            e.meta.arena_ref = (self._arena.path, off)
+        else:
+            seg = shared_memory.SharedMemory(
+                create=True, size=max(e.meta.size, 1),
+                name=_segment_name(object_id))
+            with open(e.spilled_path, "rb") as f:
+                f.readinto(seg.buf[:e.meta.size])
+            e.segment = seg
+            e.meta.shm_name = seg.name
         os.unlink(e.spilled_path)
         e.spilled_path = None
-        e.segment = seg
-        e.meta.shm_name = seg.name
         self._used += e.meta.size
         e.charged = True
         self.num_restored += 1
@@ -304,6 +435,9 @@ class ObjectStore:
     def shutdown(self) -> None:
         with self._lock:
             self.free(list(self._entries))
+            if self._arena is not None:
+                self._arena.close(unlink=True)
+                self._arena = None
 
 
 # --------------------------------------------------------------- client side
@@ -322,6 +456,11 @@ class ObjectReader:
             raise serialization.from_bytes(meta.error)
         if meta.inline is not None:
             return serialization.from_bytes(meta.inline)
+        if meta.arena_ref is not None:
+            from . import native
+            path, off = meta.arena_ref
+            reader = native.ArenaReader.get(path)
+            return serialization.read_from(reader.buffer(off, meta.size))
         with self._lock:
             seg = self._segments.get(meta.shm_name)
             if seg is None:

@@ -47,6 +47,8 @@ SUBSCRIBE_EVENTS = 22   # (req_id, channel)
 STATE_QUERY = 23        # (req_id, what, filters)
 PROFILE_EVENT = 24      # (kind, payload)
 PUT_OBJECT_SYNC = 25    # (req_id, ObjectMeta) — acked once the store adopts it
+ALLOC_OBJECT = 26       # (req_id, ObjectID, size) — arena Create; reply
+                        # INFO_REPLY (arena_path, offset) | None
 
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)

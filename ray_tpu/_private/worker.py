@@ -233,11 +233,8 @@ class WorkerRuntime:
             out = bytearray(total)
             ser.write_to(memoryview(out), smeta, views)
             return ObjectMeta(object_id=oid, size=total, inline=bytes(out))
-        seg = create_segment(oid, total)
-        ser.write_to(seg.buf, smeta, views)
-        name = seg.name
-        seg.close()
-        return ObjectMeta(object_id=oid, size=total, shm_name=name)
+        # arena Create/Seal through the local node store when available
+        return self.client.store_large(oid, smeta, views, total)
 
 
 def main() -> None:
