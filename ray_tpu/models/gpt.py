@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
-from ..ops.attention import dot_product_attention
+from ..ops.attention import attention_reference, dot_product_attention
 from ..ops.ring_attention import ring_attention
 from ..parallel.sharding import (DEFAULT_RULES, ShardingRules,
                                  with_logical_constraint)
@@ -53,6 +53,16 @@ class GPTConfig:
     positions: str = "learned"        # "learned" | "rope"
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
+    # pipeline parallelism: microbatches per global batch (0 -> = pp).
+    # Stages come from the mesh's pp axis; GSPMD-style schedule (scan
+    # over steps, stage-sharded rolling buffer -> collective-permute).
+    pp_microbatches: int = 0
+    # mixture-of-experts (0 = dense; EP is absent from the reference,
+    # SURVEY §2.4 — first-class here)
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01
     # numerics
     dtype: Any = jnp.bfloat16         # activation dtype
     param_dtype: Any = jnp.float32
@@ -89,7 +99,10 @@ class GPTConfig:
         d, f, v = self.d_model, self.ff_dim, self.vocab_size
         hd, h, hk = self.head_dim, self.n_heads, self.kv_heads
         attn = d * h * hd + 2 * d * hk * hd + h * hd * d
-        mlp = (3 if self.activation == "swiglu" else 2) * d * f
+        if self.n_experts > 0:
+            mlp = self.n_experts * 3 * d * f + d * self.n_experts
+        else:
+            mlp = (3 if self.activation == "swiglu" else 2) * d * f
         emb = v * d * (1 if self.tie_embeddings else 2)
         return self.n_layers * (attn + mlp) + emb
 
@@ -149,6 +162,24 @@ class GPT:
         self.config = config
         self.mesh = mesh
         self.rules = rules if rules is not None else DEFAULT_RULES
+        if self.pp_stages > 1:
+            if config.n_layers % self.pp_stages:
+                raise ValueError(
+                    f"n_layers={config.n_layers} must divide into "
+                    f"pp={self.pp_stages} stages")
+            if config.n_experts > 0:
+                raise NotImplementedError(
+                    "EP+PP combined (MoE aux-loss masking across pipeline "
+                    "bubbles) is not supported yet")
+
+    @property
+    def pp_stages(self) -> int:
+        if self.mesh is None:
+            return 1
+        ax = self.rules.mesh_axes("stage")
+        if isinstance(ax, str) and ax in self.mesh.shape:
+            return self.mesh.shape[ax]
+        return 1
 
     # -- parameters --------------------------------------------------------
 
@@ -159,7 +190,7 @@ class GPT:
         h, hk, L = c.n_heads, c.kv_heads, c.n_layers
         std = 0.02
         resid_std = std / math.sqrt(2 * L)
-        keys = jax.random.split(rng, 10)
+        keys = jax.random.split(rng, 12)
 
         def ones(shape):
             return jnp.ones(shape, pd)
@@ -171,11 +202,19 @@ class GPT:
             "wk": _normal(keys[1], (L, d, hk, hd), std, pd),
             "wv": _normal(keys[2], (L, d, hk, hd), std, pd),
             "wo": _normal(keys[3], (L, h, hd, d), resid_std, pd),
-            "w_up": _normal(keys[4], (L, d, f), std, pd),
-            "w_down": _normal(keys[5], (L, f, d), resid_std, pd),
         }
-        if c.activation == "swiglu":
-            blocks["w_gate"] = _normal(keys[6], (L, d, f), std, pd)
+        if c.n_experts > 0:
+            E = c.n_experts
+            blocks["router"] = _normal(keys[4], (L, d, E), std, pd)
+            blocks["w_up"] = _normal(keys[5], (L, E, d, f), std, pd)
+            blocks["w_gate"] = _normal(keys[6], (L, E, d, f), std, pd)
+            blocks["w_down"] = _normal(keys[10], (L, E, f, d), resid_std,
+                                       pd)
+        else:
+            blocks["w_up"] = _normal(keys[4], (L, d, f), std, pd)
+            blocks["w_down"] = _normal(keys[5], (L, f, d), resid_std, pd)
+            if c.activation == "swiglu":
+                blocks["w_gate"] = _normal(keys[6], (L, d, f), std, pd)
         if c.norm == "layernorm":
             blocks["bias1"] = jnp.zeros((L, d), pd)
             blocks["bias2"] = jnp.zeros((L, d), pd)
@@ -191,6 +230,13 @@ class GPT:
             params["bias_f"] = jnp.zeros((d,), pd)
         if not c.tie_embeddings:
             params["lm_head"] = _normal(keys[9], (d, c.vocab_size), std, pd)
+        P = self.pp_stages
+        if P > 1:
+            # stage-stack: [L, ...] -> [P, L/P, ...]; the stage axis is
+            # sharded over pp so each stage holds only its layers
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda a: a.reshape((P, L // P) + a.shape[1:]),
+                params["blocks"])
         return params
 
     def param_logical_axes(self) -> Params:
@@ -203,14 +249,22 @@ class GPT:
             "wk": ("layers", "embed", "kv_heads", "head_dim"),
             "wv": ("layers", "embed", "kv_heads", "head_dim"),
             "wo": ("layers", "heads", "head_dim", "embed"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
         }
-        if c.activation == "swiglu":
-            blocks["w_gate"] = ("layers", "embed", "mlp")
+        if c.n_experts > 0:
+            blocks["router"] = ("layers", "embed", None)
+            blocks["w_up"] = ("layers", "expert", "embed", "mlp")
+            blocks["w_gate"] = ("layers", "expert", "embed", "mlp")
+            blocks["w_down"] = ("layers", "expert", "mlp", "embed")
+        else:
+            blocks["w_up"] = ("layers", "embed", "mlp")
+            blocks["w_down"] = ("layers", "mlp", "embed")
+            if c.activation == "swiglu":
+                blocks["w_gate"] = ("layers", "embed", "mlp")
         if c.norm == "layernorm":
             blocks["bias1"] = ("layers", None)
             blocks["bias2"] = ("layers", None)
+        if self.pp_stages > 1:
+            blocks = {k: ("stage",) + v for k, v in blocks.items()}
         axes: Params = {
             "tok_embed": ("vocab", "embed"),
             "blocks": blocks,
@@ -275,7 +329,12 @@ class GPT:
         kt = jnp.transpose(k, (0, 2, 1, 3))
         vt = jnp.transpose(v, (0, 2, 1, 3))
         sp = self._sp_size()
-        if sp > 1:
+        if getattr(self, "_in_pipeline", False):
+            # pipeline mode runs blocks under vmap over the stage axis;
+            # shard_map can't nest there, so use the einsum attention and
+            # let GSPMD partition it (pallas-in-pipeline: future work)
+            ot = attention_reference(qt, kt, vt, causal=True)
+        elif sp > 1:
             # Specs derive from the rules table like every other sharding
             # decision; the ring axis is whatever act_seq maps to.
             spec_q = self.rules.spec("act_batch", "act_heads", "act_seq",
@@ -339,22 +398,37 @@ class GPT:
         x = x + self._constrain(attn, "act_batch", "act_seq", "act_embed")
 
         h = self._norm(x, w["norm2"], w.get("bias2"))
-        up = jnp.einsum("bsd,df->bsf", h, w["w_up"].astype(dt))
-        if c.activation == "swiglu":
-            gate = jnp.einsum("bsd,df->bsf", h, w["w_gate"].astype(dt))
-            act = jax.nn.silu(gate) * up
+        aux = jnp.zeros((), jnp.float32)
+        if c.n_experts > 0:
+            from .moe import moe_ffn
+            down, moe_metrics = moe_ffn(
+                h, w["router"], w["w_up"], w["w_gate"], w["w_down"],
+                top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor, dtype=dt)
+            aux = moe_metrics["moe_aux_loss"]
         else:
-            act = jax.nn.gelu(up, approximate=True)
-        act = self._constrain(act, "act_batch", "act_seq", "act_mlp")
-        down = jnp.einsum("bsf,fd->bsd", act, w["w_down"].astype(dt))
+            up = jnp.einsum("bsd,df->bsf", h, w["w_up"].astype(dt))
+            if c.activation == "swiglu":
+                gate = jnp.einsum("bsd,df->bsf", h,
+                                  w["w_gate"].astype(dt))
+                act = jax.nn.silu(gate) * up
+            else:
+                act = jax.nn.gelu(up, approximate=True)
+            act = self._constrain(act, "act_batch", "act_seq", "act_mlp")
+            down = jnp.einsum("bsf,fd->bsd", act, w["w_down"].astype(dt))
         x = x + self._constrain(down, "act_batch", "act_seq", "act_embed")
-        return x
+        return x, aux
 
     # -- forward -----------------------------------------------------------
 
     def apply(self, params: Params, tokens: jax.Array,
               positions: Optional[jax.Array] = None) -> jax.Array:
         """tokens: [B, S] int32 → logits [B, S, V] (f32)."""
+        return self.forward_with_aux(params, tokens, positions)[0]
+
+    def forward_with_aux(self, params: Params, tokens: jax.Array,
+                         positions: Optional[jax.Array] = None):
+        """Returns (logits, aux_losses dict) — MoE load-balance terms."""
         c = self.config
         if positions is None:
             positions = jnp.broadcast_to(
@@ -370,10 +444,16 @@ class GPT:
             block_fn = jax.checkpoint(
                 block_fn, policy=jax.checkpoint_policies.nothing_saveable)
 
-        def scan_body(x, layer_w):
-            return block_fn(x, positions, layer_w), None
+        if self.pp_stages > 1:
+            x = self._pipeline_blocks(block_fn, params["blocks"], x,
+                                      positions)
+            aux_per_layer = jnp.zeros((1,), jnp.float32)
+        else:
+            def scan_body(x, layer_w):
+                x, aux = block_fn(x, positions, layer_w)
+                return x, aux
 
-        x, _ = lax.scan(scan_body, x, params["blocks"])
+            x, aux_per_layer = lax.scan(scan_body, x, params["blocks"])
         x = self._norm(x, params["norm_f"], params.get("bias_f"))
         if c.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x,
@@ -382,7 +462,71 @@ class GPT:
             logits = jnp.einsum("bsd,dv->bsv", x,
                                 params["lm_head"].astype(c.dtype))
         logits = self._constrain(logits, "act_batch", "act_seq", "act_vocab")
-        return logits.astype(jnp.float32)
+        return logits.astype(jnp.float32), {
+            "moe_aux_loss": aux_per_layer.mean()}
+
+    def _pipeline_blocks(self, block_fn, blocks: Params, x: jax.Array,
+                         positions: jax.Array) -> jax.Array:
+        """GPipe schedule, GSPMD formulation (reference has no native PP,
+        SURVEY §2.4 — Alpa-on-Ray only). Stage-stacked params [P, L/P, …]
+        shard over pp; a [P, b, S, D] rolling buffer carries each
+        microbatch through the stages; `jnp.roll` on the stage-sharded
+        axis lowers to collective-permute over ICI. M microbatches take
+        M + P - 1 steps (the usual bubble)."""
+        c = self.config
+        P = self.pp_stages
+        B, S, D = x.shape
+        M = c.pp_microbatches or P
+        if B % M:
+            raise ValueError(f"batch {B} must divide into {M} microbatches")
+        mb = B // M
+        x_mb = x.reshape(M, mb, S, D)
+        x_mb = self._constrain(x_mb, None, "act_batch", "act_seq",
+                               "act_embed")
+        pos_mb = positions.reshape(M, mb, S)[0]
+
+        self._in_pipeline = True
+        try:
+            def stage_step(carry, t):
+                state, outs = carry
+                # shift: stage s hands its activation to stage s+1
+                state = jnp.roll(state, shift=1, axis=0)
+                # feed the next microbatch into stage 0
+                inp = lax.dynamic_index_in_dim(
+                    x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+                state = state.at[0].set(
+                    jnp.where(t < M, inp, state[0]))
+                state = self._constrain(state, "stage", "act_batch",
+                                        "act_seq", "act_embed")
+
+                # every stage applies its L/P layers (vmap over stages;
+                # per-stage scan over layers)
+                def one_stage(stage_params, xs):
+                    def body(h, layer_w):
+                        h, _ = block_fn(h, pos_mb, layer_w)
+                        return h, None
+                    out, _ = lax.scan(body, xs, stage_params)
+                    return out
+
+                state = jax.vmap(one_stage)(blocks, state)
+                state = self._constrain(state, "stage", "act_batch",
+                                        "act_seq", "act_embed")
+                # collect the last stage's output once the fill drains
+                out_idx = jnp.clip(t - (P - 1), 0, M - 1)
+                outs = lax.cond(
+                    t >= P - 1,
+                    lambda o: lax.dynamic_update_index_in_dim(
+                        o, state[P - 1], out_idx, axis=0),
+                    lambda o: o, outs)
+                return (state, outs), None
+
+            state0 = jnp.zeros((P, mb, S, D), c.dtype)
+            outs0 = jnp.zeros((M, mb, S, D), c.dtype)
+            (_, outs), _ = lax.scan(stage_step, (state0, outs0),
+                                    jnp.arange(M + P - 1))
+        finally:
+            self._in_pipeline = False
+        return outs.reshape(B, S, D)
 
     def loss(self, params: Params, batch: Dict[str, jax.Array]
              ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
@@ -393,7 +537,7 @@ class GPT:
         """
         c = self.config
         tokens = batch["tokens"]
-        logits = self.apply(params, tokens)  # [B, S, V] f32
+        logits, aux = self.forward_with_aux(params, tokens)  # [B,S,V] f32
         targets = jnp.concatenate(
             [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
         mask = jnp.concatenate(
@@ -415,4 +559,8 @@ class GPT:
             "ppl_log": (nll * mask).sum() / total,
             "tokens": mask.sum(),
         }
+        if c.n_experts > 0:
+            loss = loss + c.moe_aux_coeff * aux["moe_aux_loss"]
+            metrics["moe_aux_loss"] = aux["moe_aux_loss"]
+            metrics["loss"] = loss
         return loss, metrics

@@ -15,7 +15,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple, Union
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_SP, AXIS_TP
+from .mesh import (AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP,
+                   AXIS_TP)
 
 LogicalAxis = Optional[str]
 MeshAxes = Union[None, str, Tuple[str, ...]]
@@ -57,7 +58,7 @@ DEFAULT_RULES = ShardingRules({
     "vocab": AXIS_TP,
     "expert": AXIS_EP,
     "layers": None,
-    "stage": None,
+    "stage": AXIS_PP,
 })
 
 
