@@ -1263,6 +1263,22 @@ class NodeService:
                     for n in self.gcs.nodes.values()]
         if what == "store_stats":
             return self.store.stats()
+        if what == "workers":
+            out = []
+            for info in self.gcs.alive_nodes():
+                svc = info.service
+                if svc is None:
+                    continue
+                for wid, w in svc._workers.items():
+                    out.append({
+                        "worker_id": wid.hex(),
+                        "node_id": info.node_id.hex(),
+                        "pid": w.proc.pid if w.proc else None,
+                        "state": w.state,
+                        "actor_id": (w.actor_id.hex()
+                                     if w.actor_id else None),
+                    })
+            return out
         if what == "config":
             return CONFIG.dump()
         return None

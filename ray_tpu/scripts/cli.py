@@ -1,0 +1,167 @@
+"""rtpu CLI — cluster inspection & ops.
+
+Reference: ``python/ray/scripts/scripts.py`` (``ray status`` :1963,
+``ray memory``, ``ray timeline``, ``ray list ...`` via the state CLI,
+``experimental/state/state_cli.py``). argparse instead of click (no
+extra deps); attaches to a live session by connecting a driver client
+to its node unix socket (default: the most recent ``rtpu_session_*``).
+
+Usage:
+    python -m ray_tpu.scripts.cli status
+    python -m ray_tpu.scripts.cli list tasks|actors|objects|pgs|nodes|workers
+    python -m ray_tpu.scripts.cli summary tasks|actors
+    python -m ray_tpu.scripts.cli memory
+    python -m ray_tpu.scripts.cli timeline -o /tmp/trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _find_session(session: Optional[str]) -> str:
+    if session:
+        return session
+    candidates = sorted(glob.glob("/tmp/rtpu_session_*"),
+                        key=os.path.getmtime, reverse=True)
+    for c in candidates:
+        if glob.glob(os.path.join(c, "node_*.sock")):
+            return c
+    raise SystemExit("no live rtpu session found (pass --session)")
+
+
+def _connect(session_dir: str):
+    from .._private import context as ctx
+    from .._private import protocol as P
+    from .._private.client import CoreClient
+    from .._private.ids import JobID, WorkerID
+
+    socks = sorted(glob.glob(os.path.join(session_dir, "node_*.sock")))
+    if not socks:
+        raise SystemExit(f"no node socket in {session_dir}")
+    conn = P.connect_unix(socks[0])
+    client = CoreClient(conn, JobID.from_random(), WorkerID.from_random(),
+                        P.KIND_DRIVER)
+    conn.send((P.REGISTER, (P.KIND_DRIVER, client.worker_id.binary(),
+                            os.getpid())))
+    client.start_reader()
+    ctx.current_client = client
+    return client
+
+
+def _print_table(rows, columns) -> None:
+    if not rows:
+        print("(empty)")
+        return
+    widths = [max(len(str(r.get(c, ""))) for r in rows + [{c: c}])
+              for c in columns]
+    header = "  ".join(c.ljust(w) for c, w in zip(columns, widths))
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(w)
+                        for c, w in zip(columns, widths)))
+
+
+def cmd_status(client, args) -> None:
+    total = client.cluster_info("resources_total") or {}
+    avail = client.cluster_info("resources_available") or {}
+    nodes = client.cluster_info("nodes") or []
+    alive = sum(1 for n in nodes if n.get("alive"))
+    print(f"Nodes: {alive} alive / {len(nodes)} total")
+    print("Resources:")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+
+
+def cmd_list(client, args) -> None:
+    from ..state import (list_actors, list_nodes, list_objects,
+                         list_placement_groups, list_tasks, list_workers)
+    what = args.what
+    if what == "tasks":
+        rows = list_tasks(limit=args.limit)
+        cols = ["task_id", "name", "state", "is_actor_task"]
+    elif what == "actors":
+        rows = list_actors(limit=args.limit)
+        cols = ["actor_id", "class_name", "name", "state", "num_restarts"]
+    elif what == "objects":
+        rows = list_objects(limit=args.limit)
+        cols = ["object_id", "node_id", "size"]
+    elif what in ("pgs", "placement_groups"):
+        rows = list_placement_groups(limit=args.limit)
+        cols = ["pg_id", "strategy", "bundles"]
+    elif what == "nodes":
+        rows = [{**n, "node_id": n["node_id"].hex()
+                 if hasattr(n["node_id"], "hex") else n["node_id"]}
+                for n in list_nodes()]
+        cols = ["node_id", "alive", "resources"]
+    elif what == "workers":
+        rows = list_workers()
+        cols = ["worker_id", "pid", "state", "actor_id"]
+    else:
+        raise SystemExit(f"unknown list target {what!r}")
+    if args.format == "json":
+        print(json.dumps(rows, default=str, indent=2))
+    else:
+        _print_table(rows, cols)
+
+
+def cmd_summary(client, args) -> None:
+    from ..state import summarize_actors, summarize_tasks
+    summary = (summarize_tasks() if args.what == "tasks"
+               else summarize_actors())
+    print(json.dumps(summary, indent=2, default=str))
+
+
+def cmd_memory(client, args) -> None:
+    stats = client.cluster_info("store_stats") or {}
+    for k, v in sorted(stats.items()):
+        print(f"{k}: {v}")
+
+
+def cmd_timeline(client, args) -> None:
+    from ..state import timeline
+    out = args.output or "/tmp/rtpu_timeline.json"
+    timeline(out)
+    print(f"wrote {out} (open in chrome://tracing or ui.perfetto.dev)")
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="rtpu",
+                                     description="ray_tpu cluster CLI")
+    parser.add_argument("--session", help="session dir (default: latest)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status")
+    p_list = sub.add_parser("list")
+    p_list.add_argument("what")
+    p_list.add_argument("--limit", type=int, default=100)
+    p_list.add_argument("--format", choices=("table", "json"),
+                        default="table")
+    p_sum = sub.add_parser("summary")
+    p_sum.add_argument("what", choices=("tasks", "actors"))
+    sub.add_parser("memory")
+    p_tl = sub.add_parser("timeline")
+    p_tl.add_argument("-o", "--output")
+
+    args = parser.parse_args(argv)
+    session = _find_session(args.session)
+    client = _connect(session)
+    try:
+        {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
+         "memory": cmd_memory, "timeline": cmd_timeline}[args.command](
+             client, args)
+    finally:
+        try:
+            client.close()
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""ray_tpu.state — queryable cluster state (the state API).
+
+Reference: ``python/ray/experimental/state/api.py`` (list/get/summarize
+for tasks, actors, objects, nodes, placement groups) backed by
+``GcsTaskManager``; here the node's STATE_QUERY RPC serves the same
+records straight from the control plane.
+"""
+
+from .api import (  # noqa: F401
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_actors,
+    summarize_tasks,
+    timeline,
+)

@@ -1,0 +1,157 @@
+"""State API implementation.
+
+Reference surface: ``experimental/state/api.py`` list_* / summarize_*
+and ``ray.timeline()`` (``_private/state.py:865`` — Chrome trace JSON
+from task events).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional
+
+from .._private import context as _ctx
+
+
+def _query(what: str, filters: Optional[dict] = None) -> Any:
+    return _ctx.require_client().state_query(what, filters)
+
+
+def _apply_filters(rows: List[dict], filters: Optional[dict]) -> List[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        if all(str(row.get(k)) == str(v) for k, v in filters.items()):
+            out.append(row)
+    return out
+
+
+def list_tasks(filters: Optional[dict] = None,
+               limit: int = 1000) -> List[dict]:
+    """Task state transitions (latest state per task)."""
+    events = _query("tasks") or []
+    latest: Dict[Any, dict] = {}
+    for ev in events:
+        latest[ev["task_id"]] = {
+            "task_id": ev["task_id"].hex() if hasattr(ev["task_id"], "hex")
+            else str(ev["task_id"]),
+            "name": ev["name"],
+            "state": ev["state"],
+            "node_id": (ev["node_id"].hex()
+                        if hasattr(ev["node_id"], "hex")
+                        else str(ev["node_id"])),
+            "is_actor_task": ev.get("is_actor_task", False),
+            "timestamp": ev["timestamp"],
+        }
+    rows = sorted(latest.values(), key=lambda r: r["timestamp"])
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(filters: Optional[dict] = None,
+                limit: int = 1000) -> List[dict]:
+    rows = []
+    for rec in _query("actors") or []:
+        rows.append({
+            "actor_id": rec["actor_id"].hex()
+            if hasattr(rec["actor_id"], "hex") else str(rec["actor_id"]),
+            "class_name": rec["class_name"],
+            "name": rec.get("name"),
+            "state": rec["state"],
+            "num_restarts": rec.get("num_restarts", 0),
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(filters: Optional[dict] = None,
+                 limit: int = 1000) -> List[dict]:
+    rows = []
+    for rec in _query("objects") or []:
+        rows.append({
+            "object_id": rec["object_id"].hex()
+            if hasattr(rec["object_id"], "hex") else str(rec["object_id"]),
+            "node_id": rec["node_id"].hex()
+            if hasattr(rec["node_id"], "hex") else str(rec["node_id"]),
+            "size": rec["size"],
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(filters: Optional[dict] = None,
+                          limit: int = 1000) -> List[dict]:
+    rows = []
+    for rec in _query("placement_groups") or []:
+        rows.append({
+            "pg_id": rec["pg_id"].hex()
+            if hasattr(rec["pg_id"], "hex") else str(rec["pg_id"]),
+            "state": rec.get("state"),
+            "bundles": rec["bundles"],
+            "strategy": rec["strategy"],
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_nodes(filters: Optional[dict] = None) -> List[dict]:
+    return _apply_filters(_ctx.require_client().cluster_info("nodes") or [],
+                          filters)
+
+
+def list_workers(filters: Optional[dict] = None) -> List[dict]:
+    return _apply_filters(
+        _ctx.require_client().cluster_info("workers") or [], filters)
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Count by (name, state) — reference: ``ray summary tasks``."""
+    rows = list_tasks(limit=10**9)
+    by_state = Counter(r["state"] for r in rows)
+    by_func: Dict[str, Counter] = defaultdict(Counter)
+    for r in rows:
+        by_func[r["name"]][r["state"]] += 1
+    return {"total": len(rows), "by_state": dict(by_state),
+            "by_func": {k: dict(v) for k, v in by_func.items()}}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    rows = list_actors(limit=10**9)
+    by_state = Counter(r["state"] for r in rows)
+    by_class: Dict[str, Counter] = defaultdict(Counter)
+    for r in rows:
+        by_class[r["class_name"]][r["state"]] += 1
+    return {"total": len(rows), "by_state": dict(by_state),
+            "by_class": {k: dict(v) for k, v in by_class.items()}}
+
+
+def timeline(filename: Optional[str] = None) -> Any:
+    """Chrome-trace JSON of task execution (reference: ``ray.timeline``,
+    ``_private/state.py:865``). Load the output in chrome://tracing or
+    Perfetto."""
+    events = _query("tasks") or []
+    # pair RUNNING -> FINISHED/FAILED per task
+    runs: Dict[Any, dict] = {}
+    trace = []
+    for ev in sorted(events, key=lambda e: e["timestamp"]):
+        tid = ev["task_id"]
+        node = (ev["node_id"].hex()[:8]
+                if hasattr(ev["node_id"], "hex") else str(ev["node_id"]))
+        if ev["state"] == "RUNNING":
+            runs[tid] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and tid in runs:
+            start = runs.pop(tid)
+            trace.append({
+                "name": ev["name"],
+                "cat": "actor_task" if ev.get("is_actor_task") else "task",
+                "ph": "X",
+                "ts": start["timestamp"] * 1e6,
+                "dur": (ev["timestamp"] - start["timestamp"]) * 1e6,
+                "pid": f"node:{node}",
+                "tid": (tid.hex()[:8] if hasattr(tid, "hex")
+                        else str(tid)),
+                "args": {"state": ev["state"]},
+            })
+    if filename is not None:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+        return filename
+    return trace

@@ -1,0 +1,216 @@
+"""User-facing metrics API: Counter / Gauge / Histogram.
+
+Reference: ``python/ray/util/metrics.py`` (same three types, tag
+support) + the per-node ``MetricsAgent`` → Prometheus pipeline
+(``_private/metrics_agent.py:416``). Here every process records locally
+and pushes to a named aggregator actor (fire-and-forget); export is
+Prometheus text format via ``export_prometheus()`` or an HTTP endpoint
+(``start_metrics_http``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import remote
+
+_AGGREGATOR_NAME = "rtpu:metrics_aggregator"
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                    5.0, 10.0)
+
+
+@remote(num_cpus=0, max_concurrency=8)
+class _Aggregator:
+    def __init__(self):
+        self._counters: Dict[tuple, float] = defaultdict(float)
+        self._gauges: Dict[tuple, float] = {}
+        self._hists: Dict[tuple, List[float]] = defaultdict(list)
+        self._meta: Dict[str, dict] = {}
+
+    def record(self, kind: str, name: str, description: str,
+               tags: tuple, value: float, buckets=None) -> None:
+        key = (name, tags)
+        self._meta[name] = {"kind": kind, "description": description,
+                            "buckets": buckets}
+        if kind == "counter":
+            self._counters[key] += value
+        elif kind == "gauge":
+            self._gauges[key] = value
+        else:
+            self._hists[key].append(value)
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "histograms": {k: list(v) for k, v in self._hists.items()},
+            "meta": dict(self._meta),
+        }
+
+
+_agg_cache = None          # (client, actor) — invalidated on re-init
+_agg_lock = threading.Lock()
+
+
+def _get_aggregator(create: bool = True):
+    """Named-actor rendezvous. Creation can race across workers — the
+    loser's creation fails (duplicate name), so confirm with a real call
+    and fall back to lookup."""
+    global _agg_cache
+    from .. import get, get_actor
+    from .._private import context as _ctx
+    client = _ctx.require_client()
+    with _agg_lock:
+        if _agg_cache is not None and _agg_cache[0] is client:
+            return _agg_cache[1]
+        _agg_cache = None
+        try:
+            actor = get_actor(_AGGREGATOR_NAME)
+            _agg_cache = (client, actor)
+            return actor
+        except ValueError:
+            if not create:
+                return None
+        try:
+            actor = _Aggregator.options(name=_AGGREGATOR_NAME,
+                                        lifetime="detached").remote()
+            get(actor.snapshot.remote())    # forces creation to resolve
+            _agg_cache = (client, actor)
+            return actor
+        except Exception:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                try:
+                    actor = get_actor(_AGGREGATOR_NAME)
+                    _agg_cache = (client, actor)
+                    return actor
+                except ValueError:
+                    time.sleep(0.05)
+            raise
+
+
+class _Metric:
+    KIND = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._buckets = None
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "_Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags_tuple(self, tags: Optional[Dict[str, str]]) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+    def _record(self, value: float, tags: Optional[Dict[str, str]]):
+        agg = _get_aggregator()
+        agg.record.remote(self.KIND, self._name, self._description,
+                          self._tags_tuple(tags), float(value),
+                          self._buckets)
+
+
+class Counter(_Metric):
+    KIND = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        self._record(value, tags)
+
+
+class Gauge(_Metric):
+    KIND = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        self._record(value, tags)
+
+
+class Histogram(_Metric):
+    KIND = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = _DEFAULT_BUCKETS,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._buckets = tuple(boundaries)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        self._record(value, tags)
+
+
+def export_prometheus() -> str:
+    """Prometheus text exposition of all recorded metrics."""
+    from .. import get
+    agg = _get_aggregator(create=False)
+    if agg is None:
+        return ""
+    snap = get(agg.snapshot.remote())
+    lines: List[str] = []
+
+    def fmt_tags(tags: tuple) -> str:
+        if not tags:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in tags)
+        return "{" + inner + "}"
+
+    meta = snap["meta"]
+    for (name, tags), value in sorted(snap["counters"].items()):
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}{fmt_tags(tags)} {value}")
+    for (name, tags), value in sorted(snap["gauges"].items()):
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{fmt_tags(tags)} {value}")
+    for (name, tags), values in sorted(snap["histograms"].items()):
+        buckets = (meta.get(name, {}).get("buckets")
+                   or _DEFAULT_BUCKETS)
+        lines.append(f"# TYPE {name} histogram")
+        cumulative = 0
+        for b in buckets:
+            cumulative = sum(1 for v in values if v <= b)
+            tag_str = fmt_tags(tags + (("le", str(b)),))
+            lines.append(f"{name}_bucket{tag_str} {cumulative}")
+        inf_tags = fmt_tags(tags + (("le", "+Inf"),))
+        lines.append(f"{name}_bucket{inf_tags} {len(values)}")
+        lines.append(f"{name}_sum{fmt_tags(tags)} {sum(values)}")
+        lines.append(f"{name}_count{fmt_tags(tags)} {len(values)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+_http_server = None
+
+
+def start_metrics_http(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Serve GET /metrics in Prometheus format (reference: the per-node
+    agent's scrape endpoint)."""
+    global _http_server
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = export_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    _http_server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=_http_server.serve_forever,
+                     daemon=True).start()
+    return f"http://{host}:{_http_server.server_address[1]}/metrics"

@@ -1,0 +1,121 @@
+"""State API, timeline, metrics, and CLI tests."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state as rstate
+from ray_tpu.util import metrics as rmetrics
+
+
+def test_list_tasks_and_actors(rtpu_init):
+    @ray_tpu.remote
+    def work(x):
+        return x
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    a = A.options(name="state_actor").remote()
+    ray_tpu.get(a.ping.remote())
+
+    tasks = rstate.list_tasks()
+    # names are __qualname__ — closures carry a <locals> prefix
+    assert any(t["name"].endswith("work") and t["state"] == "FINISHED"
+               for t in tasks)
+    actors = rstate.list_actors()
+    assert any(r["class_name"] == "A" and r["state"] == "ALIVE"
+               for r in actors)
+    workers = rstate.list_workers()
+    assert workers and all("pid" in w for w in workers)
+
+    summary = rstate.summarize_tasks()
+    assert summary["total"] >= 3
+    work_counts = [v for k, v in summary["by_func"].items()
+                   if k.endswith("work")]
+    assert work_counts and work_counts[0]["FINISHED"] == 3
+
+
+def test_timeline_chrome_trace(rtpu_init, tmp_path):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([slow.remote() for _ in range(2)])
+    out = str(tmp_path / "trace.json")
+    rstate.timeline(out)
+    with open(out) as f:
+        trace = json.load(f)
+    spans = [e for e in trace if e["name"].endswith("slow")]
+    assert len(spans) == 2
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in spans)
+
+
+def test_metrics_counter_gauge_histogram(rtpu_init):
+    c = rmetrics.Counter("test_requests", "reqs", tag_keys=("route",))
+    g = rmetrics.Gauge("test_depth", "queue depth")
+    h = rmetrics.Histogram("test_latency", "latency",
+                           boundaries=(0.1, 1.0))
+    c.inc(tags={"route": "a"})
+    c.inc(2.0, tags={"route": "a"})
+    g.set(7.0)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    time.sleep(0.3)     # fire-and-forget records land
+
+    text = rmetrics.export_prometheus()
+    assert 'test_requests{route="a"} 3.0' in text
+    assert "test_depth 7.0" in text
+    assert "test_latency_count 3" in text
+    assert 'test_latency_bucket{le="0.1"} 1' in text
+
+    url = rmetrics.start_metrics_http()
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        body = resp.read().decode()
+    assert "test_depth 7.0" in body
+
+
+def test_metrics_from_workers(rtpu_init):
+    @ray_tpu.remote
+    def emit(i):
+        from ray_tpu.util.metrics import Counter
+        Counter("worker_side_events", "").inc()
+        return i
+
+    ray_tpu.get([emit.remote(i) for i in range(4)])
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if "worker_side_events 4.0" in rmetrics.export_prometheus():
+            break
+        time.sleep(0.1)
+    assert "worker_side_events 4.0" in rmetrics.export_prometheus()
+
+
+def test_cli_subprocess(rtpu_init):
+    @ray_tpu.remote
+    def job(x):
+        return x
+
+    ray_tpu.get([job.remote(i) for i in range(2)])
+    session = ray_tpu._session_dir
+    for argv in (["status"], ["list", "tasks"], ["summary", "tasks"],
+                 ["memory"]):
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli",
+             "--session", session] + argv,
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+    status = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "--session",
+         session, "status"], capture_output=True, text=True, timeout=60)
+    assert "Nodes: 1 alive" in status.stdout
