@@ -45,6 +45,7 @@ def init(address: Optional[Any] = None,
          namespace: str = "default",
          object_store_memory: Optional[int] = None,
          ignore_reinit_error: bool = False,
+         runtime_env: Optional[Dict[str, Any]] = None,
          _system_config: Optional[Dict[str, Any]] = None) -> None:
     """Start a local node (head) and connect, or connect to an existing
     in-process cluster (pass a ``cluster_utils.Cluster``).
@@ -100,6 +101,8 @@ def init(address: Optional[Any] = None,
     client.start_reader()
     client.namespace = namespace
     client.node_id = _global_node.node_id
+    from ._private import runtime_env as _renv
+    client.job_runtime_env = _renv.validate(runtime_env)
     _ctx.current_client = client
     _global_gcs.register_job(JobRecord(job_id=job_id, driver_pid=os.getpid(),
                                        start_time=time.time()))

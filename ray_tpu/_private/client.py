@@ -294,7 +294,8 @@ class CoreClient:
     def submit_task(self, function_id: bytes, name: str, args, kwargs,
                     num_returns: int, resources: Dict[str, float],
                     max_retries: int, scheduling_strategy=None,
-                    retry_exceptions: bool = False) -> List[ObjectRef]:
+                    retry_exceptions: bool = False,
+                    runtime_env: Optional[dict] = None) -> List[ObjectRef]:
         task_id = TaskID.for_job(self.job_id)
         packed, pkw = self.pack_args(args, kwargs)
         return_ids = [ObjectID.for_task_return(task_id, i)
@@ -307,7 +308,8 @@ class CoreClient:
             retry_exceptions=retry_exceptions,
             scheduling_strategy=scheduling_strategy,
             owner_id=self.worker_id.binary(),
-            namespace=self._active_namespace())
+            namespace=self._active_namespace(),
+            runtime_env=runtime_env)
         self._send(P.SUBMIT_TASK, spec)
         return [ObjectRef(oid) for oid in return_ids]
 

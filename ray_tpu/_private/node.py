@@ -47,6 +47,9 @@ class _Worker:
     task: Optional["_TaskRecord"] = None
     actor_id: Optional[ActorID] = None
     started_at: float = field(default_factory=time.monotonic)
+    # runtime-env pool key (reference: WorkerPool keyed by runtime env,
+    # ``worker_pool.h:152``); "" = the default environment
+    env_key: str = ""
 
 
 @dataclass
@@ -553,12 +556,15 @@ class NodeService:
             if not self._try_acquire(rec):
                 remaining.append(rec)
                 continue
-            wid = self._acquire_worker()
+            env_key = self._rec_env_key(rec)
+            wid = self._acquire_worker(env_key)
             if wid is None:
                 self._release_charge(rec)
                 remaining.append(rec)
-                self._maybe_spawn_worker()
-                break
+                self._maybe_spawn_worker(rec)
+                # a different-env task behind this one may still have an
+                # idle worker; keep scanning instead of breaking
+                continue
             self._assign(rec, wid)
         self._pending.extend(remaining)
 
@@ -589,22 +595,47 @@ class NodeService:
                 sched.add(self.resources_available, rec.charge)
         rec.charge = None
 
-    def _acquire_worker(self) -> Optional[WorkerID]:
+    def _rec_env_key(self, rec: "_TaskRecord") -> str:
+        from . import runtime_env as renv
+        spec_env = (rec.actor_spec.runtime_env
+                    if rec.actor_spec is not None
+                    else rec.spec.runtime_env)
+        return renv.env_key(spec_env)
+
+    def _rec_runtime_env(self, rec: "_TaskRecord") -> Optional[dict]:
+        return (rec.actor_spec.runtime_env if rec.actor_spec is not None
+                else rec.spec.runtime_env)
+
+    def _acquire_worker(self, env_key: str = "") -> Optional[WorkerID]:
+        """Pop an idle worker whose runtime env matches (pool keyed by
+        env, reference: ``WorkerPool::PopWorker``)."""
+        kept = []
+        found = None
         while self._idle:
             wid = self._idle.popleft()
             w = self._workers.get(wid)
-            if w is not None and w.state == "IDLE":
-                return wid
-        return None
+            if w is None or w.state != "IDLE":
+                continue
+            if w.env_key == env_key:
+                found = wid
+                break
+            kept.append(wid)
+        self._idle.extendleft(reversed(kept))
+        return found
 
-    def _maybe_spawn_worker(self) -> None:
+    def _maybe_spawn_worker(self, rec: Optional["_TaskRecord"] = None
+                            ) -> None:
         self._reap_startup_failures()
         active = sum(1 for w in self._workers.values() if w.state != "DEAD")
         if active >= self._max_workers:
             return
         if self._num_starting >= CONFIG.maximum_startup_concurrency:
             return
-        self._spawn_worker()
+        if rec is not None:
+            self._spawn_worker(self._rec_env_key(rec),
+                               self._rec_runtime_env(rec))
+        else:
+            self._spawn_worker()
 
     def _reap_startup_failures(self) -> None:
         """Workers that died before registering never produce a conn_closed
@@ -623,7 +654,10 @@ class NodeService:
                 del self._workers[wid]
                 self._num_starting = max(0, self._num_starting - 1)
 
-    def _spawn_worker(self) -> WorkerID:
+    def _spawn_worker(self, env_key: str = "",
+                      worker_runtime_env: Optional[dict] = None
+                      ) -> WorkerID:
+        from . import runtime_env as renv
         wid = WorkerID.from_random()
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -634,13 +668,21 @@ class NodeService:
         # disable TPU-attach hooks in sitecustomize (saves ~2s/spawn).
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
+        cwd = os.getcwd()
+        if worker_runtime_env:
+            overrides, env_cwd = renv.stage(worker_runtime_env,
+                                            self.session_dir)
+            env.update(overrides)
+            if env_cwd:
+                cwd = env_cwd
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker",
              self.socket_path, self.node_id.hex(), wid.hex()],
             stdout=out, stderr=subprocess.STDOUT, env=env,
-            cwd=os.getcwd())
+            cwd=cwd)
         out.close()
-        self._workers[wid] = _Worker(worker_id=wid, proc=proc)
+        self._workers[wid] = _Worker(worker_id=wid, proc=proc,
+                                     env_key=env_key)
         self._num_starting += 1
         return wid
 

@@ -98,6 +98,7 @@ class TaskSpec:
     scheduling_strategy: Any = None          # None | "SPREAD" | NodeAffinity | PG
     owner_id: bytes = b""                    # WorkerID binary of the submitter
     namespace: str = "default"               # submitter's job namespace
+    runtime_env: Optional[dict] = None       # validated runtime env
 
 
 @dataclass
@@ -120,6 +121,7 @@ class ActorSpec:
     lifetime: Optional[str] = None           # None | "detached"
     scheduling_strategy: Any = None
     creation_return_id: Optional[ObjectID] = None
+    runtime_env: Optional[dict] = None       # validated runtime env
 
 
 @dataclass

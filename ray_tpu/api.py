@@ -38,6 +38,24 @@ def _build_resources(opts: Dict[str, Any], default_cpus: float) -> Dict[str, flo
     return {k: v for k, v in res.items() if v}
 
 
+def _resolve_runtime_env(opts, client):
+    """Merge the job-level runtime env (init(runtime_env=...)) with the
+    per-task/actor one; env_vars merge key-wise, other keys override
+    (reference: runtime-env inheritance semantics)."""
+    from ._private import runtime_env as renv
+    job_env = getattr(client, "job_runtime_env", None)
+    task_env = renv.validate(opts.get("runtime_env"))
+    if not job_env:
+        return task_env
+    if not task_env:
+        return job_env
+    merged = {**job_env, **task_env}
+    if "env_vars" in job_env or "env_vars" in task_env:
+        merged["env_vars"] = {**job_env.get("env_vars", {}),
+                              **task_env.get("env_vars", {})}
+    return merged
+
+
 class RemoteFunction:
     """A function callable via ``.remote()`` (reference:
     ``remote_function.py:40``; submission path ``_remote`` :257)."""
@@ -80,7 +98,8 @@ class RemoteFunction:
             max_retries=opts.get("max_retries",
                                  CONFIG.task_max_retries_default),
             scheduling_strategy=opts.get("scheduling_strategy"),
-            retry_exceptions=opts.get("retry_exceptions", False))
+            retry_exceptions=opts.get("retry_exceptions", False),
+            runtime_env=_resolve_runtime_env(opts, client))
         if num_returns == 1:
             return refs[0]
         return refs
@@ -216,7 +235,8 @@ class ActorClass:
             is_async=self._detect_async(),
             lifetime=opts.get("lifetime"),
             scheduling_strategy=opts.get("scheduling_strategy"),
-            creation_return_id=creation_return)
+            creation_return_id=creation_return,
+            runtime_env=_resolve_runtime_env(opts, client))
         client.create_actor(spec)
         handle = ActorHandle(actor_id, self._cls.__name__,
                              self._method_options())

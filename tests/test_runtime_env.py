@@ -1,0 +1,79 @@
+"""Runtime env tests (reference model: ``python/ray/tests/
+test_runtime_env*.py`` — env_vars, working_dir, pool isolation)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_env_vars_per_task(rtpu_init):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "hello"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+    # default-env workers must NOT see the variable (pool isolation)
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_env_vars_actor(rtpu_init):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_ENV": "42"}})
+    class A:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    assert ray_tpu.get(A.remote().read.remote()) == "42"
+
+
+def test_working_dir(rtpu_init, tmp_path):
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "my_module_rtpu_test.py").write_text("VALUE = 'from_wd'\n")
+    (pkg / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+    def use_wd():
+        import my_module_rtpu_test
+        with open("data.txt") as f:
+            return my_module_rtpu_test.VALUE, f.read()
+
+    assert ray_tpu.get(use_wd.remote()) == ("from_wd", "payload")
+
+
+def test_job_level_runtime_env(tmp_path):
+    ray_tpu.init(num_cpus=2,
+                 runtime_env={"env_vars": {"JOB_WIDE": "yes"}})
+    try:
+        @ray_tpu.remote
+        def read():
+            return os.environ.get("JOB_WIDE")
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"EXTRA": "1"}})
+        def read_both():
+            return (os.environ.get("JOB_WIDE"), os.environ.get("EXTRA"))
+
+        assert ray_tpu.get(read.remote()) == "yes"
+        assert ray_tpu.get(read_both.remote()) == ("yes", "1")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_rejected_keys(rtpu_init):
+    with pytest.raises(Exception):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def f():
+            pass
+
+        f.remote()
+
+    from ray_tpu._private.runtime_env import validate
+    with pytest.raises(ValueError):
+        validate({"conda": "env.yml"})
+    with pytest.raises(ValueError):
+        validate({"bogus_key": 1})
