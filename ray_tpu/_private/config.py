@@ -42,6 +42,15 @@ _CONFIG_DEFS: Dict[str, tuple] = {
     "idle_worker_killing_time_s": (float, 300.0, "kill idle workers after this long"),
     "worker_register_timeout_s": (float, 30.0, "worker registration handshake timeout"),
     "maximum_startup_concurrency": (int, 16, "max concurrent worker process launches"),
+    "worker_startup_max_failures": (int, 3,
+                                    "consecutive startup failures per runtime env "
+                                    "before pending tasks fail with "
+                                    "RuntimeEnvSetupError (reference: PopWorker "
+                                    "failure callback)"),
+    "arena_free_quarantine_s": (float, 30.0,
+                                "freed arena blocks whose object was ever read "
+                                "are quarantined this long before reuse "
+                                "(readers may hold zero-copy views)"),
     # --- health / failure ---
     "health_check_period_ms": (int, 3000,
                                "control-plane liveness ping period "

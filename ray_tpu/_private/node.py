@@ -50,6 +50,8 @@ class _Worker:
     # runtime-env pool key (reference: WorkerPool keyed by runtime env,
     # ``worker_pool.h:152``); "" = the default environment
     env_key: str = ""
+    idle_since: float = 0.0
+    log_path: Optional[str] = None
 
 
 @dataclass
@@ -126,6 +128,12 @@ class NodeService:
         self._idle: deque = deque()
         self._num_starting = 0
         self._max_workers = max(int(resources.get("CPU", 4)) * 2, 8)
+        # consecutive startup failures per env_key; after
+        # CONFIG.worker_startup_max_failures, pending tasks needing that
+        # env fail fast instead of respawning forever (reference:
+        # PopWorker failure callback, ``worker_pool.h:152``)
+        self._env_spawn_failures: Dict[str, int] = {}
+        self._env_spawn_error: Dict[str, str] = {}
 
         self._pending: deque = deque()                    # ready-to-dispatch
         self._waiting_deps: Dict[TaskID, _TaskRecord] = {}
@@ -172,7 +180,14 @@ class NodeService:
                                   daemon=True)
         t_acc.start()
         t_disp.start()
-        self._threads += [t_acc, t_disp]
+        # Periodic tick: the dispatch loop otherwise only wakes on events,
+        # so a worker that dies before ever connecting (e.g. a broken
+        # runtime env) would leave its pending task asleep forever.
+        t_tick = threading.Thread(target=self._tick_loop,
+                                  name=f"rtpu-tick-{self.node_id.hex()[:6]}",
+                                  daemon=True)
+        t_tick.start()
+        self._threads += [t_acc, t_disp, t_tick]
 
     def stop(self, kill_workers: bool = True) -> None:
         if self._stopped.is_set():
@@ -249,6 +264,17 @@ class NodeService:
                                  daemon=True)
             t.start()
 
+    def _tick_loop(self) -> None:
+        while not self._stopped.wait(1.0):
+            self._events.put(("timer", self._on_tick))
+
+    def _on_tick(self) -> None:
+        self._reap_startup_failures()
+        self._reap_idle_workers()
+        # _dispatch fails pending tasks whose env exceeded the startup
+        # failure budget (see the wid-None path)
+        self._dispatch()
+
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
             msg = conn.recv()
@@ -311,9 +337,9 @@ class NodeService:
                 w.conn = self._conns[key]
                 w.conn_key = key
                 self._num_starting = max(0, self._num_starting - 1)
+                self._env_spawn_failures.pop(w.env_key, None)
                 if w.state == "STARTING":
-                    w.state = "IDLE"
-                    self._idle.append(wid)
+                    self._mark_idle(w)
                 self._dispatch()
             else:
                 self._driver_conn_keys.add(key)
@@ -528,15 +554,20 @@ class NodeService:
         if loc is None:
             return None
         nid, meta = loc
+        svc = self._service_of(nid)
+        if svc is not None and svc.store is not self.store:
+            # Always route cross-node reads through the owning store:
+            # get_meta marks the entry read (ever_read) and restores
+            # spilled entries, so the owner will never spill-and-free an
+            # arena block whose bytes a remote reader's zero-copy views
+            # still alias. Returning the directory-shared meta directly
+            # bypassed that tracking (silent corruption under memory
+            # pressure). Reference analogue: reads go through the primary
+            # raylet's plasma store / RestoreSpilledObjects
+            # (``local_object_manager.h:110``).
+            return svc.store.get_meta(oid)
         if (meta.shm_name is None and meta.inline is None
                 and meta.error is None and meta.arena_ref is None):
-            # The owning node spilled it (spilling blanks shm_name on the
-            # directory-shared meta); restore through that node's store —
-            # reference analogue: RestoreSpilledObjects via the primary
-            # raylet (``local_object_manager.h:110``).
-            store = self._owning_store(oid)
-            if store is not None and store is not self.store:
-                return store.get_meta(oid)
             return None
         return meta
 
@@ -549,6 +580,7 @@ class NodeService:
         if not self._pending:
             return
         remaining = deque()
+        failed_envs: Set[str] = set()
         while self._pending:
             rec = self._pending.popleft()
             if rec.cancelled:
@@ -560,6 +592,18 @@ class NodeService:
             wid = self._acquire_worker(env_key)
             if wid is None:
                 self._release_charge(rec)
+                if (self._env_spawn_failures.get(env_key, 0)
+                        >= CONFIG.worker_startup_max_failures):
+                    failed_envs.add(env_key)
+                    # workers for this env die on startup repeatedly —
+                    # fail fast instead of pending forever (reference:
+                    # PopWorker status callback, ``worker_pool.h:152``)
+                    self._fail_pending_rec(rec, exceptions.RuntimeEnvSetupError(
+                        f"workers for task {rec.spec.name!r} failed to "
+                        f"start {CONFIG.worker_startup_max_failures} times; "
+                        "last worker log tail:\n"
+                        + self._env_spawn_error.get(env_key, "<no log>")))
+                    continue
                 remaining.append(rec)
                 self._maybe_spawn_worker(rec)
                 # a different-env task behind this one may still have an
@@ -567,6 +611,29 @@ class NodeService:
                 continue
             self._assign(rec, wid)
         self._pending.extend(remaining)
+        # fresh budget for future submissions: the blacklist applies to
+        # tasks pending in this pass, not to the env forever
+        for env in failed_envs:
+            self._env_spawn_failures.pop(env, None)
+
+    def _fail_pending_rec(self, rec: _TaskRecord, exc: Exception) -> None:
+        """Fail a queued (never-dispatched) task record."""
+        self._unpin_deps(rec)
+        self._record_event(rec.spec, "FAILED")
+        # seal the creation/return refs with the root-cause error first;
+        # _handle_actor_death below then sees them sealed and won't
+        # overwrite with a generic ActorDiedError
+        self._fail_returns(rec.spec, exc)
+        if rec.kind == "actor_create" and rec.actor_spec is not None:
+            aid = rec.actor_spec.actor_id
+            st = self._actors.get(aid)
+            if st is not None:
+                # a restart would hit the same broken env; full death path
+                # also drains queued method calls (they'd hang otherwise)
+                st["no_restart"] = True
+                self._handle_actor_death(aid, str(exc))
+            else:
+                self.gcs.set_actor_state(aid, ACTOR_DEAD, reason=str(exc))
 
     def _try_acquire(self, rec: _TaskRecord) -> bool:
         demand = rec.spec.resources
@@ -626,33 +693,117 @@ class NodeService:
     def _maybe_spawn_worker(self, rec: Optional["_TaskRecord"] = None
                             ) -> None:
         self._reap_startup_failures()
+        env_key = self._rec_env_key(rec) if rec is not None else ""
         active = sum(1 for w in self._workers.values() if w.state != "DEAD")
         if active >= self._max_workers:
-            return
+            # pool full of other-env workers would starve this env forever;
+            # evict one idle mismatched worker to make room (reference:
+            # WorkerPool idle eviction, ``worker_pool.h:152``)
+            if not self._evict_idle_worker(exclude_env=env_key):
+                return
         if self._num_starting >= CONFIG.maximum_startup_concurrency:
             return
         if rec is not None:
-            self._spawn_worker(self._rec_env_key(rec),
-                               self._rec_runtime_env(rec))
+            self._spawn_worker(env_key, self._rec_runtime_env(rec))
         else:
             self._spawn_worker()
 
+    def _evict_idle_worker(self, exclude_env: str) -> bool:
+        """Kill one idle worker whose env differs from ``exclude_env``."""
+        for wid in list(self._idle):
+            w = self._workers.get(wid)
+            if w is None or w.state != "IDLE" or w.env_key == exclude_env:
+                continue
+            self._kill_worker(wid)
+            return True
+        return False
+
+    def _kill_worker(self, wid: WorkerID) -> None:
+        w = self._workers.pop(wid, None)
+        if w is None:
+            return
+        try:
+            self._idle.remove(wid)
+        except ValueError:
+            pass
+        w.state = "DEAD"
+        if w.conn_key is not None:
+            self._conn_worker.pop(w.conn_key, None)
+        if w.proc is not None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+
+    def _reap_idle_workers(self) -> None:
+        """Kill workers idle beyond CONFIG.idle_worker_killing_time_s,
+        keeping a floor of num_cpus default-env workers warm (reference:
+        ``WorkerPool::TryKillingIdleWorkers``)."""
+        timeout = CONFIG.idle_worker_killing_time_s
+        if timeout <= 0:
+            return
+        floor = int(self.resources_total.get("CPU", 0))
+        now = time.monotonic()
+        n_default = sum(
+            1 for wid in self._idle
+            if (w := self._workers.get(wid)) is not None
+            and w.state == "IDLE" and w.env_key == "")
+        for wid in list(self._idle):
+            w = self._workers.get(wid)
+            if (w is None or w.state != "IDLE"
+                    or now - w.idle_since < timeout):
+                continue
+            if w.env_key == "":
+                # the warm floor applies to default-env workers only
+                if n_default <= floor:
+                    continue
+                n_default -= 1
+            self._kill_worker(wid)
+
+    def _mark_idle(self, w: _Worker) -> None:
+        w.state = "IDLE"
+        w.task = None
+        w.idle_since = time.monotonic()
+        self._idle.append(w.worker_id)
+
+    def _worker_log_tail(self, w: _Worker, nbytes: int = 2048) -> str:
+        if not w.log_path:
+            return "<no log>"
+        try:
+            with open(w.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - nbytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return "<log unreadable>"
+
     def _reap_startup_failures(self) -> None:
         """Workers that died before registering never produce a conn_closed
-        event; reap them here so startup slots aren't leaked forever."""
+        event; reap them here so startup slots aren't leaked forever, and
+        count consecutive per-env failures so tasks can fail fast."""
         now = time.monotonic()
         for wid, w in list(self._workers.items()):
             if w.state != "STARTING" or w.proc is None:
                 continue
             if (w.proc.poll() is not None
                     or now - w.started_at > CONFIG.worker_register_timeout_s):
-                if w.proc.poll() is None:
+                died = w.proc.poll() is not None
+                if not died:
                     try:
                         w.proc.kill()
                     except OSError:
                         pass
                 del self._workers[wid]
                 self._num_starting = max(0, self._num_starting - 1)
+                if died:
+                    # only processes that exited on their own count toward
+                    # the env failure budget — a slow registration (killed
+                    # at the timeout) is load, not a broken env, and must
+                    # not blacklist the default pool
+                    self._env_spawn_failures[w.env_key] = (
+                        self._env_spawn_failures.get(w.env_key, 0) + 1)
+                    self._env_spawn_error[w.env_key] = self._worker_log_tail(w)
 
     def _spawn_worker(self, env_key: str = "",
                       worker_runtime_env: Optional[dict] = None
@@ -661,7 +812,8 @@ class NodeService:
         wid = WorkerID.from_random()
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{wid.hex()[:12]}.log"), "ab")
+        log_path = os.path.join(log_dir, f"worker-{wid.hex()[:12]}.log")
+        out = open(log_path, "ab")
         env = dict(os.environ)
         env["RTPU_WORKER"] = "1"
         # Workers never grab the TPU; the driver owns device compute. Also
@@ -675,6 +827,15 @@ class NodeService:
             env.update(overrides)
             if env_cwd:
                 cwd = env_cwd
+        # The framework may be importable only via the driver's cwd (not
+        # installed); a runtime_env working_dir changes the worker's cwd,
+        # so make ray_tpu importable explicitly. Appended last: staged
+        # user code shadows it.
+        fw_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        pp = env.get("PYTHONPATH", "")
+        if fw_root not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = (pp + os.pathsep if pp else "") + fw_root
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker",
              self.socket_path, self.node_id.hex(), wid.hex()],
@@ -682,7 +843,7 @@ class NodeService:
             cwd=cwd)
         out.close()
         self._workers[wid] = _Worker(worker_id=wid, proc=proc,
-                                     env_key=env_key)
+                                     env_key=env_key, log_path=log_path)
         self._num_starting += 1
         return wid
 
@@ -725,9 +886,7 @@ class NodeService:
             return
         self._release_charge(rec)
         if w is not None and w.state == "BUSY":
-            w.state = "IDLE"
-            w.task = None
-            self._idle.append(w.worker_id)
+            self._mark_idle(w)
         if rec.kind == "actor_call" and w is not None:
             w.task = None
         self._dispatch()
@@ -846,10 +1005,8 @@ class NodeService:
                                      reason="creation task failed")
             w = self._workers.get(rec.worker_id)
             if w is not None:
-                w.state = "IDLE"
                 w.actor_id = None
-                w.task = None
-                self._idle.append(w.worker_id)
+                self._mark_idle(w)
             return
         # actor keeps its resource charge for its lifetime
         if st is not None:

@@ -135,6 +135,11 @@ class ObjectStore:
         # node; all readers attach once. Optional: pure-python segments
         # remain the fallback and the path for huge objects.
         self._arena = None
+        # freed-while-read arena blocks: (release_at, offset). A reader's
+        # zero-copy numpy views alias arena bytes with no kernel refcount
+        # (unlike POSIX segments), so reuse is delayed by
+        # CONFIG.arena_free_quarantine_s after an explicit free().
+        self._quarantine: List[tuple] = []
         if CONFIG.use_native_arena:
             try:
                 from . import native
@@ -195,6 +200,7 @@ class ObjectStore:
         if self._arena is None or size > self.ARENA_MAX_OBJECT:
             return None
         with self._lock:
+            self._sweep_quarantine()
             if object_id in self._entries:
                 return None
             self._ensure_capacity(size)
@@ -306,8 +312,29 @@ class ObjectStore:
             if e is not None and e.pinned > 0:
                 e.pinned -= 1
 
+    def _free_arena_block(self, e: _Entry) -> None:
+        """Release an owned arena block; quarantine it if any reader may
+        still hold zero-copy views into it (ADVICE r1: unconditional free
+        reused blocks under live readers → silent corruption)."""
+        off = e.meta.arena_ref[1]
+        if e.ever_read and CONFIG.arena_free_quarantine_s > 0:
+            self._quarantine.append(
+                (time.monotonic() + CONFIG.arena_free_quarantine_s, off))
+        else:
+            self._arena.free(off)
+
+    def _sweep_quarantine(self) -> None:
+        """Callers hold _lock. Deadlines are appended in monotonic order
+        (constant delay), so sweeping the prefix is enough."""
+        now = time.monotonic()
+        while self._quarantine and self._quarantine[0][0] <= now:
+            _, off = self._quarantine.pop(0)
+            self._arena.free(off)
+
     def free(self, object_ids: List[ObjectID]) -> None:
         with self._lock:
+            if self._arena is not None:
+                self._sweep_quarantine()
             for oid in object_ids:
                 e = self._entries.pop(oid, None)
                 if e is None:
@@ -319,7 +346,7 @@ class ObjectStore:
                     # another node's arena object are metadata-only
                     if (self._arena is not None
                             and e.meta.arena_ref[0] == self._arena.path):
-                        self._arena.free(e.meta.arena_ref[1])
+                        self._free_arena_block(e)
                 elif e.segment is not None:
                     try:
                         e.segment.close()
@@ -354,6 +381,7 @@ class ObjectStore:
             if self._arena is not None:
                 out["arena_used_bytes"] = self._arena.used
                 out["arena_num_blocks"] = self._arena.num_blocks
+                out["arena_quarantined_blocks"] = len(self._quarantine)
             return out
 
     # ------------------------------------------------------- spill/restore

@@ -14,6 +14,7 @@ from __future__ import annotations
 import functools
 import queue as _queue
 import threading
+import time as _time
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional
 
@@ -39,10 +40,16 @@ class _Batcher:
         while True:
             item = self.q.get()          # (arg, future)
             batch = [item]
-            deadline = self.timeout_s
+            # absolute deadline per batch: a fixed per-get timeout would
+            # reset on every arrival, making the first caller wait up to
+            # (max_batch_size-1)*timeout under a trickle of requests
+            deadline = _time.monotonic() + self.timeout_s
             while len(batch) < self.max_batch_size:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
                 try:
-                    batch.append(self.q.get(timeout=deadline))
+                    batch.append(self.q.get(timeout=remaining))
                 except _queue.Empty:
                     break
             args = [a for a, _ in batch]

@@ -52,6 +52,10 @@ class ASHAScheduler:
             t *= reduction_factor
         # rung -> list of recorded metric values
         self._recorded: Dict[int, List[float]] = {r: [] for r in self.rungs}
+        # trial -> highest rung already judged (so a trial whose
+        # time_attr jumps over a rung value still gets judged exactly
+        # once per rung, at the first report past it)
+        self._trial_rung: Dict[Any, int] = {}
 
     def _better(self, value: float, peers: List[float]) -> bool:
         """Is value in the top 1/rf quantile of peers (self included)?"""
@@ -68,8 +72,13 @@ class ASHAScheduler:
         value = result.get(self.metric)
         if value is None:
             return CONTINUE
+        judged = self._trial_rung.get(trial, -1)
         for rung in reversed(self.rungs):
-            if t == rung:
+            # first report at-or-past a rung not yet judged for this
+            # trial triggers the halving decision (exact equality let
+            # trials whose time_attr skips rung values run to max_t)
+            if t >= rung and rung > judged:
+                self._trial_rung[trial] = rung
                 peers = self._recorded[rung]
                 keep = self._better(float(value), peers)
                 peers.append(float(value))
@@ -77,7 +86,7 @@ class ASHAScheduler:
         return CONTINUE
 
     def on_trial_complete(self, trial) -> None:
-        pass
+        self._trial_rung.pop(trial, None)
 
 
 class PopulationBasedTraining:

@@ -116,3 +116,82 @@ def test_arena_spill_restore_roundtrip(tmp_path):
                 assert data == payload
     finally:
         store.shutdown()
+
+
+def test_free_while_read_quarantines_block(tmp_path):
+    """free() of an arena object whose meta was handed to a reader must
+    not reuse the block immediately — readers may hold zero-copy views
+    (ADVICE r1 #2)."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectMeta, ObjectStore
+
+    store = ObjectStore(capacity_bytes=4 << 20, spill_dir=str(tmp_path))
+    if store._arena is None:
+        pytest.skip("arena unavailable")
+    old = CONFIG._values["arena_free_quarantine_s"]
+    CONFIG._values["arena_free_quarantine_s"] = 0.3
+    try:
+        oid = ObjectID.from_random()
+        ref = store.alloc_in_arena(oid, 4096)
+        assert ref is not None
+        store.adopt(ObjectMeta(object_id=oid, size=4096, arena_ref=ref))
+        assert store.get_meta(oid) is not None      # marks ever_read
+        store.free([oid])
+        # block must be quarantined, not reusable at the same offset
+        assert store.stats()["arena_quarantined_blocks"] == 1
+        oid2 = ObjectID.from_random()
+        ref2 = store.alloc_in_arena(oid2, 4096)
+        assert ref2 is not None and ref2[1] != ref[1]
+        # after the quarantine window the block returns to the arena
+        import time
+        time.sleep(0.35)
+        oid3 = ObjectID.from_random()
+        ref3 = store.alloc_in_arena(oid3, 4096)
+        assert ref3 is not None
+        assert store.stats()["arena_quarantined_blocks"] == 0
+    finally:
+        CONFIG._values["arena_free_quarantine_s"] = old
+        store.shutdown()
+
+
+def test_never_read_arena_free_is_immediate(tmp_path):
+    """Objects nobody ever read are freed without quarantine."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectMeta, ObjectStore
+
+    store = ObjectStore(capacity_bytes=4 << 20, spill_dir=str(tmp_path))
+    if store._arena is None:
+        pytest.skip("arena unavailable")
+    try:
+        oid = ObjectID.from_random()
+        ref = store.alloc_in_arena(oid, 4096)
+        store.adopt(ObjectMeta(object_id=oid, size=4096, arena_ref=ref))
+        used = store._arena.used
+        store.free([oid])
+        assert store.stats()["arena_quarantined_blocks"] == 0
+        assert store._arena.used < used
+    finally:
+        store.shutdown()
+
+
+def test_cross_node_get_marks_owner_read(rtpu_cluster):
+    """A remote node's get() must route through the owning store so the
+    entry is marked ever_read and can never be spilled-and-freed under a
+    live zero-copy reader (ADVICE r1 #1, high)."""
+    cluster = rtpu_cluster
+    worker_node = cluster.add_node(num_cpus=2, resources={"side": 1.0})
+
+    @ray_tpu.remote(resources={"side": 1.0})
+    def produce():
+        return np.arange(300_000, dtype=np.float64)  # > inline threshold
+
+    ref = produce.remote()
+    arr = ray_tpu.get(ref, timeout=60)
+    assert arr[5] == 5.0
+    oid = ref.id
+    entry = worker_node.store._entries.get(oid)
+    if entry is None or entry.meta.arena_ref is None:
+        pytest.skip("object not arena-backed on the worker node")
+    assert entry.ever_read, (
+        "cross-node get() bypassed the owner's read tracking")

@@ -77,3 +77,71 @@ def test_rejected_keys(rtpu_init):
         validate({"conda": "env.yml"})
     with pytest.raises(ValueError):
         validate({"bogus_key": 1})
+
+
+def test_broken_env_fails_fast(rtpu_init, tmp_path):
+    """Workers that die on startup must fail the task with
+    RuntimeEnvSetupError instead of pending forever (ADVICE r1 /
+    reference: PopWorker failure callback, ``worker_pool.h:152``)."""
+    pkg = tmp_path / "broken"
+    pkg.mkdir()
+    # staged working_dir becomes the worker's cwd (= sys.path[0]), so
+    # this file shadows the real package and kills the worker at import
+    (pkg / "ray_tpu.py").write_text("raise ImportError('shadowed')\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+    def f():
+        return 1
+
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+    with pytest.raises(RuntimeEnvSetupError):
+        ray_tpu.get(f.remote(), timeout=60)
+
+
+def test_env_pool_eviction_no_starvation(tmp_path):
+    """A pool full of idle other-env workers must evict one instead of
+    starving a new env forever (ADVICE r1 #3)."""
+    ray_tpu.init(num_cpus=4)
+    try:
+        node = ray_tpu._global_node
+
+        @ray_tpu.remote
+        def whoami():
+            return os.getpid()
+
+        # fill the pool to _max_workers with distinct env keys
+        n_fill = node._max_workers
+        for i in range(n_fill):
+            env = {"env_vars": {"POOL_FILL": str(i)}}
+            assert ray_tpu.get(
+                whoami.options(runtime_env=env).remote(), timeout=60) > 0
+        alive = sum(1 for w in node._workers.values()
+                    if w.state != "DEAD")
+        assert alive >= node._max_workers  # genuinely full
+
+        # a fresh env must still get a worker (via idle eviction)
+        out = ray_tpu.get(whoami.options(
+            runtime_env={"env_vars": {"POOL_FILL": "fresh"}}).remote(),
+            timeout=60)
+        assert out > 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_broken_env_actor_fails_queued_calls(rtpu_init, tmp_path):
+    """An actor whose workers can't start must fail its creation ref AND
+    any method calls queued while it was pending — not leave them
+    hanging."""
+    pkg = tmp_path / "broken_actor"
+    pkg.mkdir()
+    (pkg / "ray_tpu.py").write_text("raise ImportError('shadowed')\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ref = a.ping.remote()          # queued while the actor is pending
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=60)

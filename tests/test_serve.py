@@ -113,3 +113,38 @@ def test_autoscaling_up(serve_session):
     for r in responses:
         r.result(timeout=30)
     assert scaled, "autoscaler never added a replica under load"
+
+
+def test_batch_deadline_is_absolute():
+    """Under a trickle of requests arriving faster than the batch
+    timeout, the first caller must not wait longer than ~timeout — the
+    deadline is absolute per batch, not reset per arrival (ADVICE r1 #4)."""
+    import threading
+    import time as _t
+
+    from ray_tpu.serve.batching import _Batcher
+
+    b = _Batcher(lambda xs: [len(xs)] * len(xs),
+                 max_batch_size=100, timeout_s=0.25)
+    first_latency = {}
+
+    def first():
+        t0 = _t.monotonic()
+        b.submit(0)
+        first_latency["dt"] = _t.monotonic() - t0
+
+    t = threading.Thread(target=first)
+    t.start()
+    # trickle: one request every 80ms for ~1.2s — with a per-arrival
+    # reset the batch would only close after the trickle ends
+    feeders = []
+    for i in range(15):
+        _t.sleep(0.08)
+        th = threading.Thread(target=b.submit, args=(i + 1,))
+        th.start()
+        feeders.append(th)
+    t.join(timeout=5)
+    for th in feeders:
+        th.join(timeout=5)
+    assert first_latency["dt"] < 0.8, (
+        f"first caller waited {first_latency['dt']:.2f}s (deadline reset)")

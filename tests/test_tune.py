@@ -120,3 +120,36 @@ def test_tuner_restore_reruns_unfinished(rtpu_init, tmp_path):
     grid2 = restored.fit()
     assert not grid2.errors
     assert grid2.get_best_result().metrics["score"] == 1
+
+
+def test_asha_judges_trials_that_skip_rung_values():
+    """Trials whose time_attr jumps over a rung value must still face
+    the halving decision at the first report past it (ADVICE r1 #5)."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, ASHAScheduler
+
+    s = ASHAScheduler(metric="loss", mode="min", max_t=30,
+                      grace_period=1, reduction_factor=3.0)
+    assert s.rungs == [1, 3, 9, 27]
+
+    # seed rung 1 and 3 with good peers (even reports: t = 2, 4, ...)
+    for trial in ("good_a", "good_b", "good_c"):
+        assert s.on_result(trial, {"training_iteration": 2,
+                                   "loss": 0.1}) == CONTINUE
+        assert s.on_result(trial, {"training_iteration": 4,
+                                   "loss": 0.1}) == CONTINUE
+
+    # a bad trial reporting only even iterations never hits t == rung
+    # exactly; it must still be stopped
+    decisions = []
+    for t in (2, 4, 6, 8, 10):
+        d = s.on_result("bad", {"training_iteration": t, "loss": 9.9})
+        decisions.append(d)
+        if d == STOP:
+            break
+    assert STOP in decisions, f"bad trial never halved: {decisions}"
+
+    # each rung judges a trial at most once: a good trial reporting
+    # t=2 twice is only recorded once at rung 1
+    before = len(s._recorded[1])
+    s.on_result("good_a", {"training_iteration": 2, "loss": 0.1})
+    assert len(s._recorded[1]) == before
