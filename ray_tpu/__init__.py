@@ -64,16 +64,45 @@ def init(address: Optional[Any] = None,
         CONFIG.reload(_system_config)
 
     job_id = JobID.from_random()
+    head_tcp_address = None
     if address is not None:
-        # attach to an in-process multi-node cluster (tests / tools)
         from . import cluster_utils
         if isinstance(address, cluster_utils.Cluster):
-            cluster = address
-            _global_gcs = cluster.gcs
-            _global_node = cluster.head
-            _session_dir = cluster.session_dir
+            if address.process_isolated:
+                address = address.gcs_address
+            else:
+                # attach to an in-process multi-node cluster (tests/tools)
+                cluster = address
+                _global_gcs = cluster.gcs
+                _global_node = cluster.head
+                _session_dir = cluster.session_dir
+                _owns_cluster = False
+                address = None
+        if isinstance(address, str):
+            # attach to a networked cluster: "host:port" of the head GCS
+            # (reference analogue: ``ray.init(address=...)`` joining a
+            # running cluster). The driver must share a host with the
+            # head node — object payloads ride /dev/shm.
+            from ._private.gcs_service import RemoteControlPlane
+            import json as _json
+            _global_gcs = RemoteControlPlane(address)
+            try:
+                head = _global_gcs.kv_get(b"__rtpu_head_node")
+                if head is None:
+                    raise ConnectionError(
+                        f"no head node registered at {address}")
+            except BaseException:
+                # don't leak the channel/reader thread or a stale global
+                _global_gcs.close()
+                _global_gcs = None
+                raise
+            head = _json.loads(head)
+            head_tcp_address = head["address"]
+            _global_node = None
+            _session_dir = None
             _owns_cluster = False
-        else:
+        elif address is not None and not isinstance(
+                address, cluster_utils.Cluster):
             raise ValueError(f"unsupported address: {address!r}")
     else:
         _session_dir = tempfile.mkdtemp(prefix="rtpu_session_")
@@ -94,13 +123,19 @@ def init(address: Optional[Any] = None,
         _global_node.start()
         _owns_cluster = True
 
-    conn = _P.connect_unix(_global_node.socket_path)
+    if _global_node is not None:
+        conn = _P.connect_unix(_global_node.socket_path)
+        node_id = _global_node.node_id
+    else:
+        from ._private.ids import NodeID as _NodeID
+        conn = _P.connect_address(head_tcp_address)
+        node_id = _NodeID.from_hex(head["node_id"])
     client = CoreClient(conn, job_id, WorkerID.from_random(), _P.KIND_DRIVER)
     conn.send((_P.REGISTER, (_P.KIND_DRIVER, client.worker_id.binary(),
                              os.getpid())))
     client.start_reader()
     client.namespace = namespace
-    client.node_id = _global_node.node_id
+    client.node_id = node_id
     from ._private import runtime_env as _renv
     client.job_runtime_env = _renv.validate(runtime_env)
     _ctx.current_client = client
@@ -142,6 +177,11 @@ def shutdown() -> None:
         if _session_dir:
             import shutil
             shutil.rmtree(_session_dir, ignore_errors=True)
+    if _global_gcs is not None and hasattr(_global_gcs, "close"):
+        try:
+            _global_gcs.close()   # remote attach: drop the GCS channel
+        except Exception:
+            pass
     _global_node = None
     _global_gcs = None
     _session_dir = None

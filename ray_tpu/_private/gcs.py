@@ -38,13 +38,30 @@ PG_REMOVED = "REMOVED"
 @dataclass
 class NodeInfo:
     node_id: NodeID
-    address: str                      # unix socket path of its service
+    address: str                      # unix socket path OR "host:port" (TCP)
     resources_total: Dict[str, float]
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
     # in-process shortcut to the NodeService (same-process multi-node cluster)
     service: Any = None
+    # OS-host identity: node processes on one host share /dev/shm, so
+    # same-host peers exchange objects zero-copy by shm name while
+    # cross-host peers pull payload bytes (reference: local plasma vs
+    # ``object_manager.h:117`` chunked Push/Pull)
+    host: str = ""
+    # availability reported with heartbeats (RaySyncer-equivalent resource
+    # gossip for nodes the scheduler can't snapshot in-process)
+    resources_available: Dict[str, float] = field(default_factory=dict)
+
+    def __getstate__(self):
+        # the live service object never crosses the wire
+        state = dict(self.__dict__)
+        state["service"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
 
 @dataclass
@@ -95,6 +112,10 @@ class GlobalControlPlane:
 
     # ------------------------------------------------------------- nodes
     def register_node(self, info: NodeInfo) -> None:
+        # re-stamp on OUR clock: a remote registrant's monotonic stamp is
+        # incomparable with this host's and could instantly trip the
+        # heartbeat sweeper
+        info.last_heartbeat = time.monotonic()
         with self._lock:
             self.nodes[info.node_id] = info
         self.publish("NODE", {"node_id": info.node_id, "state": "ALIVE"})
@@ -124,11 +145,23 @@ class GlobalControlPlane:
         with self._lock:
             return [n for n in self.nodes.values() if n.alive]
 
-    def heartbeat(self, node_id: NodeID) -> None:
+    def heartbeat(self, node_id: NodeID,
+                  resources_available: Optional[Dict[str, float]] = None
+                  ) -> None:
         with self._lock:
             info = self.nodes.get(node_id)
             if info:
                 info.last_heartbeat = time.monotonic()
+                if resources_available is not None:
+                    info.resources_available = resources_available
+
+    def get_node(self, node_id: NodeID) -> Optional[NodeInfo]:
+        with self._lock:
+            return self.nodes.get(node_id)
+
+    def nodes_snapshot(self) -> List[NodeInfo]:
+        with self._lock:
+            return list(self.nodes.values())
 
     def cluster_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -168,6 +201,10 @@ class GlobalControlPlane:
                     (rec.spec.namespace, rec.spec.registered_name), None)
         self.publish("ACTOR", {"actor_id": actor_id, "state": state,
                                "reason": reason})
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorRecord]:
+        with self._lock:
+            return self.actors.get(actor_id)
 
     def lookup_named_actor(self, name: str,
                            namespace: str = "default") -> Optional[ActorRecord]:
@@ -239,6 +276,22 @@ class GlobalControlPlane:
             if rec:
                 rec["state"] = PG_REMOVED
             return rec
+
+    # --------------------------------------------------------- snapshots
+    # Explicit copies for state queries: both the in-process plane and the
+    # remote client expose these, so node.py never touches raw attributes.
+    def actors_snapshot(self) -> List[Tuple[ActorID, ActorRecord]]:
+        with self._lock:
+            return list(self.actors.items())
+
+    def directory_snapshot(self) -> List[Tuple[ObjectID,
+                                               Tuple[NodeID, ObjectMeta]]]:
+        with self._lock:
+            return list(self.directory.items())
+
+    def pgs_snapshot(self) -> List[Tuple[PlacementGroupID, dict]]:
+        with self._lock:
+            return list(self.placement_groups.items())
 
     # ------------------------------------------------------------- events
     def record_task_event(self, ev: TaskEvent) -> None:

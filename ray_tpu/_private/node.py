@@ -32,6 +32,7 @@ from .gcs import (ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING,
                   GlobalControlPlane, NodeInfo, TaskEvent)
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import ObjectMeta, ObjectStore
+from .rpc import RpcChannel
 from .serialization import to_bytes
 
 _WORKER_STATES = ("STARTING", "IDLE", "BUSY", "ACTOR", "DEAD")
@@ -98,6 +99,117 @@ class _Waiter:
     fired: bool = False
 
 
+class _RemotePeer:
+    """Handle to a node service in another OS process (network plane).
+
+    Carries the cross-node surface ``NodeService`` uses on its peers:
+    task/actor forwarding (``post_remote``), the object plane
+    (``get_meta``/``pin_and_get``/``unpin``) and PG bundle reservation.
+    Same-host peers exchange objects by shm name (zero-copy through
+    /dev/shm); cross-host peers pull payload bytes and adopt a local
+    secondary copy (reference: ``object_manager.h:117`` Push/Pull).
+    Requests are answered on the peer's connection-reader thread, never
+    its dispatcher, so two nodes calling into each other cannot
+    deadlock."""
+
+    def __init__(self, node: "NodeService", info):
+        self.node = node
+        self.node_id = info.node_id
+        self.same_host = bool(info.host) and info.host == node.host
+        self._chan = RpcChannel(P.connect_address(info.address, timeout=10.0))
+        self._timeout = CONFIG.worker_lease_timeout_s
+        self.dead = False
+
+    @property
+    def closed(self) -> bool:
+        return self._chan.closed
+
+    def close(self) -> None:
+        self._chan.close()
+
+    def post_remote(self, item: tuple) -> None:
+        try:
+            self._chan.send(P.NODE_POST, item)
+        except OSError:
+            pass
+
+    # ----- object plane (duck-types the ObjectStore read surface)
+    def get_meta(self, oid: ObjectID) -> Optional[ObjectMeta]:
+        try:
+            if self.same_host:
+                return self._chan.request(
+                    P.OBJ_GET_META, lambda r: (r, oid, False),
+                    timeout=self._timeout)
+            return self._pull(oid, pin=False)
+        except Exception:
+            return None
+
+    def pin_and_get(self, oid: ObjectID) -> Optional[ObjectMeta]:
+        try:
+            if self.same_host:
+                return self._chan.request(
+                    P.OBJ_GET_META, lambda r: (r, oid, True),
+                    timeout=self._timeout)
+            return self._pull(oid, pin=True)
+        except Exception:
+            return None
+
+    def unpin(self, oid: ObjectID) -> None:
+        if self.same_host:
+            try:
+                self._chan.send(P.OBJ_UNPIN, oid)
+            except OSError:
+                pass
+        else:
+            self.node.store.unpin(oid)
+
+    def _pull(self, oid: ObjectID, pin: bool) -> Optional[ObjectMeta]:
+        store = self.node.store
+        if store.contains(oid):
+            return store.pin_and_get(oid) if pin else store.get_meta(oid)
+        res = self._chan.request(P.OBJ_PULL, lambda r: (r, oid),
+                                 timeout=self._timeout)
+        if res is None:
+            return None
+        meta, data = res
+        if data is None:
+            return meta          # inline / error values travel in the meta
+        store.adopt_payload(oid, data)
+        return store.pin_and_get(oid) if pin else store.get_meta(oid)
+
+    # ----- placement groups
+    def reserve_bundle(self, pg_key: tuple, demand: Dict[str, float]) -> bool:
+        try:
+            return bool(self._chan.request(
+                P.PG_RESERVE, lambda r: (r, pg_key, demand),
+                timeout=self._timeout))
+        except Exception:
+            return False
+
+    def release_bundle(self, pg_key: tuple) -> None:
+        try:
+            self._chan.send(P.PG_RELEASE, pg_key)
+        except OSError:
+            pass
+
+    def peek(self, oid: ObjectID) -> Optional[ObjectMeta]:
+        """Metadata-only existence probe: never transfers the payload
+        (a cross-host wait() on a huge object must not download it)."""
+        try:
+            return self._chan.request(P.OBJ_GET_META,
+                                      lambda r: (r, oid, False),
+                                      timeout=self._timeout)
+        except Exception:
+            return None
+
+    def node_stats(self, what: str) -> Any:
+        try:
+            return self._chan.request(P.NODE_STATS, lambda r: (r, what),
+                                      timeout=self._timeout)
+        except Exception:
+            return None
+
+
 class NodeService:
     """One per node. ``head=True`` also hosts the control plane."""
 
@@ -152,32 +264,56 @@ class NodeService:
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
         self._listener: Optional[socket.socket] = None
+        self._tcp_listener: Optional[socket.socket] = None
+        self.tcp_address: Optional[str] = None
         self._driver_conn_keys: Set[int] = set()
         self.dead = False
+
+        # OS-host identity for the object plane (same host = shared
+        # /dev/shm); overridable to simulate cross-host transfer in tests
+        self.host = os.environ.get("RTPU_NODE_HOST") or socket.gethostname()
+        self._peers: Dict[NodeID, _RemotePeer] = {}
 
         self._rng = random.Random(self.node_id.binary())
 
     # ----------------------------------------------------------- lifecycle
-    def start(self, labels: Optional[Dict[str, str]] = None) -> None:
+    def start(self, labels: Optional[Dict[str, str]] = None,
+              tcp_port: Optional[int] = None,
+              advertise_host: str = "127.0.0.1") -> None:
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
         self._listener.listen(128)
+        if tcp_port is not None:
+            # network plane: peers/drivers in other OS processes connect
+            # here; the unix socket stays the local worker fast path
+            self._tcp_listener = P.listen_tcp(port=tcp_port)
+            self.tcp_address = (
+                f"{advertise_host}:{self._tcp_listener.getsockname()[1]}")
         self.gcs.register_node(NodeInfo(
-            node_id=self.node_id, address=self.socket_path,
+            node_id=self.node_id,
+            address=self.tcp_address or self.socket_path,
             resources_total=dict(self.resources_total),
-            labels=labels or {}, service=self))
+            labels=labels or {}, service=self, host=self.host,
+            resources_available=dict(self.resources_total)))
         self.gcs.subscribe("OBJECT", self._on_object_published)
         self.gcs.subscribe("NODE", self._on_node_event)
         self.gcs.subscribe("TASK_FINISHED", self._on_task_finished)
         self.gcs.subscribe("ACTOR", self._on_actor_event)
         t_acc = threading.Thread(target=self._accept_loop,
+                                 args=(self._listener,),
                                  name=f"rtpu-accept-{self.node_id.hex()[:6]}",
                                  daemon=True)
         t_disp = threading.Thread(target=self._dispatch_loop,
                                   name=f"rtpu-dispatch-{self.node_id.hex()[:6]}",
                                   daemon=True)
+        if self._tcp_listener is not None:
+            t_tcp = threading.Thread(
+                target=self._accept_loop, args=(self._tcp_listener,),
+                name=f"rtpu-accept-tcp-{self.node_id.hex()[:6]}", daemon=True)
+            t_tcp.start()
+            self._threads.append(t_tcp)
         t_acc.start()
         t_disp.start()
         # Periodic tick: the dispatch loop otherwise only wakes on events,
@@ -194,12 +330,19 @@ class NodeService:
             return
         self._stopped.set()
         self.dead = True
-        self.gcs.remove_node(self.node_id, reason="node stopped")
-        if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+        try:
+            self.gcs.remove_node(self.node_id, reason="node stopped")
+        except Exception:   # remote GCS may already be gone
+            pass
+        for listener in (self._listener, self._tcp_listener):
+            if listener is not None:
+                try:
+                    listener.close()
+                except OSError:
+                    pass
+        for peer in list(self._peers.values()):
+            peer.close()
+        self._peers.clear()
         self._events.put(("stop",))
         if kill_workers:
             for w in list(self._workers.values()):
@@ -250,10 +393,10 @@ class NodeService:
         self._events.put(item)
 
     # ------------------------------------------------------------- threads
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, listener) -> None:
         while not self._stopped.is_set():
             try:
-                sock, _ = self._listener.accept()
+                sock, _ = listener.accept()
             except OSError:
                 return
             conn = P.Connection(sock)
@@ -266,6 +409,14 @@ class NodeService:
 
     def _tick_loop(self) -> None:
         while not self._stopped.wait(1.0):
+            # Heartbeat from THIS thread, not the dispatcher: a slow peer
+            # RPC can block the dispatcher past the GCS death deadline
+            # (health period × threshold), and a healthy node must not be
+            # declared dead because one transfer is slow.
+            try:
+                self.gcs.heartbeat(self.node_id, self.available_snapshot())
+            except Exception:
+                pass
             self._events.put(("timer", self._on_tick))
 
     def _on_tick(self) -> None:
@@ -275,13 +426,83 @@ class NodeService:
         # failure budget (see the wid-None path)
         self._dispatch()
 
+    # Ops answered inline on the connection-reader thread. The object
+    # plane and bundle reservation are thread-safe (store RLock /
+    # _res_lock) and MUST NOT wait on the dispatcher: peer A's
+    # dispatcher may be blocked on a request to B while B's is blocked
+    # on a request to A.
+    _DIRECT_OPS = frozenset({P.NODE_POST, P.OBJ_GET_META, P.OBJ_UNPIN,
+                             P.OBJ_PULL, P.PG_RESERVE, P.PG_RELEASE,
+                             P.NODE_STATS})
+
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
             msg = conn.recv()
             if msg is None:
                 self._events.put(("conn_closed", key))
                 return
-            self._events.put(("msg", key, msg))
+            if msg[0] in self._DIRECT_OPS:
+                try:
+                    self._handle_direct(key, *msg)
+                except Exception:
+                    import traceback
+                    traceback.print_exc(file=sys.stderr)
+                    # request-type ops carry (req_id, ...): answer None
+                    # so the caller doesn't block out its full timeout
+                    op, payload = msg
+                    if op in (P.OBJ_GET_META, P.OBJ_PULL, P.PG_RESERVE,
+                              P.NODE_STATS) and isinstance(payload, tuple):
+                        result = False if op == P.PG_RESERVE else None
+                        self._reply(key, P.INFO_REPLY,
+                                    (payload[0], result))
+            else:
+                self._events.put(("msg", key, msg))
+
+    def _handle_direct(self, key: int, op: int, payload: Any) -> None:
+        if op == P.NODE_POST:
+            self._events.put(tuple(payload))
+        elif op == P.OBJ_GET_META:
+            req_id, oid, pin = payload
+            meta = (self.store.pin_and_get(oid) if pin
+                    else self.store.get_meta(oid))
+            self._reply(key, P.INFO_REPLY, (req_id, meta))
+        elif op == P.OBJ_UNPIN:
+            self.store.unpin(payload)
+        elif op == P.OBJ_PULL:
+            req_id, oid = payload
+            self._reply(key, P.INFO_REPLY,
+                        (req_id, self.store.read_payload(oid)))
+        elif op == P.PG_RESERVE:
+            req_id, pg_key, demand = payload
+            self._reply(key, P.INFO_REPLY,
+                        (req_id, self.reserve_bundle(tuple(pg_key), demand)))
+        elif op == P.PG_RELEASE:
+            self.release_bundle(tuple(payload))
+        elif op == P.NODE_STATS:
+            req_id, what = payload
+            self._reply(key, P.INFO_REPLY, (req_id, self.node_stats(what)))
+
+    def node_stats(self, what: str) -> Any:
+        """Cross-thread node introspection (also served to peers)."""
+        if what == "available":
+            return self.available_snapshot()
+        if what == "store":
+            return self.store.stats()
+        if what == "workers":
+            for _ in range(3):   # dict may be mutated by the dispatcher
+                try:
+                    return [{
+                        "worker_id": wid.hex(),
+                        "node_id": self.node_id.hex(),
+                        "pid": w.proc.pid if w.proc else None,
+                        "state": w.state,
+                        "actor_id": (w.actor_id.hex()
+                                     if w.actor_id else None),
+                    } for wid, w in list(self._workers.items())]
+                except RuntimeError:
+                    continue
+            return []
+        return None
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -434,15 +655,45 @@ class NodeService:
         out = []
         for info in self.gcs.alive_nodes():
             svc = info.service
-            if svc is None or svc.dead:
-                continue
-            out.append((info.node_id, dict(info.resources_total),
-                        svc.available_snapshot()))
+            if svc is not None:
+                if svc.dead:
+                    continue
+                avail = svc.available_snapshot()
+            else:
+                # remote process: availability from heartbeat gossip
+                # (RaySyncer-equivalent; staleness is absorbed by the
+                # target node's pending queue)
+                avail = dict(info.resources_available
+                             or info.resources_total)
+            out.append((info.node_id, dict(info.resources_total), avail))
         return out
 
-    def _service_of(self, node_id: NodeID) -> Optional["NodeService"]:
-        info = self.gcs.nodes.get(node_id)
-        return info.service if info and info.alive else None
+    def _peer(self, node_id: NodeID):
+        """Handle to a node: self, an in-process NodeService, or a
+        _RemotePeer over TCP. None if the node is dead/unreachable."""
+        if node_id == self.node_id:
+            return self
+        info = self.gcs.get_node(node_id)
+        if info is None or not info.alive:
+            return None
+        if info.service is not None:
+            return None if info.service.dead else info.service
+        rp = self._peers.get(node_id)
+        if rp is None or rp.closed:
+            try:
+                rp = _RemotePeer(self, info)
+            except OSError:
+                return None
+            self._peers[node_id] = rp
+        return rp
+
+    def _peer_store(self, node_id: NodeID):
+        """The object-plane surface of a peer (get_meta / pin_and_get /
+        unpin): the in-process store, or the _RemotePeer itself."""
+        peer = self._peer(node_id)
+        if peer is None:
+            return None
+        return peer.store if isinstance(peer, NodeService) else peer
 
     def _submit_task(self, spec: P.TaskSpec) -> None:
         self._owned[spec.task_id] = _OwnedTask(
@@ -468,12 +719,12 @@ class NodeService:
         if target == self.node_id:
             self._queue_local(spec, "task")
         else:
-            svc = self._service_of(target)
-            if svc is None:
+            peer = self._peer(target)
+            if peer is None:
                 self._fail_returns(spec, exceptions.WorkerCrashedError(
                     "target node died before dispatch"))
                 return
-            svc.post_remote(("remote_task", spec))
+            peer.post_remote(("remote_task", spec))
 
     def _pg_target_node(self, strategy) -> Optional[NodeID]:
         pg = self.gcs.get_pg(strategy.pg_id())
@@ -536,15 +787,32 @@ class NodeService:
         rec.pinned_stores = {}
 
     def _owning_store(self, oid: ObjectID):
-        """The store holding the primary copy: ours, or (via the object
-        directory) the owning node's in an in-process cluster."""
+        """The object-plane handle holding the primary copy: our store,
+        the owning node's store (in-process cluster), or a _RemotePeer
+        (network plane)."""
         if self.store.contains(oid):
             return self.store
         loc = self.gcs.lookup_location(oid)
         if loc is None:
             return None
-        svc = self._service_of(loc[0])
-        return svc.store if svc is not None else None
+        return self._peer_store(loc[0])
+
+    def _object_exists(self, oid: ObjectID) -> bool:
+        """Existence probe for wait()/readiness checks: metadata only,
+        never pulls a cross-host payload (that happens at read time)."""
+        if self.store.contains(oid):
+            return True
+        loc = self.gcs.lookup_location(oid)
+        if loc is None:
+            return False
+        handle = self._peer_store(loc[0])
+        if handle is None:
+            # owner unreachable; the directory-shared meta is the best
+            # evidence (an actual get will pull or fail loudly)
+            return loc[1].has_value()
+        if isinstance(handle, _RemotePeer):
+            return handle.peek(oid) is not None
+        return handle.get_meta(oid) is not None
 
     def _lookup_object(self, oid: ObjectID) -> Optional[ObjectMeta]:
         meta = self.store.get_meta(oid)
@@ -554,8 +822,8 @@ class NodeService:
         if loc is None:
             return None
         nid, meta = loc
-        svc = self._service_of(nid)
-        if svc is not None and svc.store is not self.store:
+        remote = self._peer_store(nid)
+        if remote is not None and remote is not self.store:
             # Always route cross-node reads through the owning store:
             # get_meta marks the entry read (ever_read) and restores
             # spilled entries, so the owner will never spill-and-free an
@@ -565,7 +833,7 @@ class NodeService:
             # pressure). Reference analogue: reads go through the primary
             # raylet's plasma store / RestoreSpilledObjects
             # (``local_object_manager.h:110``).
-            return svc.store.get_meta(oid)
+            return remote.get_meta(oid)
         if (meta.shm_name is None and meta.inline is None
                 and meta.error is None and meta.arena_ref is None):
             return None
@@ -970,7 +1238,18 @@ class NodeService:
         if target == self.node_id:
             self._local_create_actor(spec)
         else:
-            self._service_of(target).post_remote(("remote_actor_create", spec))
+            peer = self._peer(target)
+            if peer is None:
+                self.gcs.set_actor_state(spec.actor_id, ACTOR_DEAD,
+                                         reason="target node died")
+                if spec.creation_return_id:
+                    err = to_bytes(exceptions.ActorDiedError(
+                        spec.actor_id, "target node died before creation"))
+                    self._seal_object(ObjectMeta(
+                        object_id=spec.creation_return_id, size=len(err),
+                        error=err))
+                return
+            peer.post_remote(("remote_actor_create", spec))
 
     def _creation_task_spec(self, spec: P.ActorSpec) -> P.TaskSpec:
         return P.TaskSpec(
@@ -1024,7 +1303,7 @@ class NodeService:
     def _submit_actor_task(self, spec: P.TaskSpec) -> None:
         self._owned[spec.task_id] = _OwnedTask(
             spec=spec, kind="actor_call", retries_left=spec.max_retries)
-        rec = self.gcs.actors.get(spec.actor_id)
+        rec = self.gcs.get_actor(spec.actor_id)
         if rec is None or rec.state == ACTOR_DEAD:
             self._fail_returns(spec, exceptions.ActorDiedError(
                 spec.actor_id, rec.death_reason if rec else "unknown actor"))
@@ -1034,12 +1313,12 @@ class NodeService:
         if rec.node_id == self.node_id or rec.node_id is None:
             self._local_actor_task(spec)
         else:
-            svc = self._service_of(rec.node_id)
-            if svc is None:
+            peer = self._peer(rec.node_id)
+            if peer is None:
                 self._fail_returns(spec, exceptions.ActorDiedError(
                     spec.actor_id, "actor node is dead"))
                 return
-            svc.post_remote(("remote_actor_task", spec))
+            peer.post_remote(("remote_actor_task", spec))
 
     def _local_actor_task(self, spec: P.TaskSpec) -> None:
         st = self._actors.get(spec.actor_id)
@@ -1102,15 +1381,15 @@ class NodeService:
             self._events.put(("conn_closed", w.conn_key))
 
     def _kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
-        rec = self.gcs.actors.get(actor_id)
+        rec = self.gcs.get_actor(actor_id)
         if rec is None:
             return
         if rec.node_id == self.node_id or rec.node_id is None:
             self._local_kill_actor(actor_id, no_restart)
         else:
-            svc = self._service_of(rec.node_id)
-            if svc is not None:
-                svc.post_remote(("remote_kill_actor", actor_id, no_restart))
+            peer = self._peer(rec.node_id)
+            if peer is not None:
+                peer.post_remote(("remote_kill_actor", actor_id, no_restart))
 
     def _local_kill_actor(self, actor_id: ActorID, no_restart: bool,
                           reason: str = "killed via kill()") -> None:
@@ -1152,8 +1431,7 @@ class NodeService:
             # creation never sealed it (worker died mid-__init__), so a
             # waiter on the ready-ref unblocks when the restart completes.
             if (spec.creation_return_id
-                    and self._lookup_object(spec.creation_return_id)
-                    is not None):
+                    and self._object_exists(spec.creation_return_id)):
                 tspec.return_ids = []
             self._queue_local(tspec, "actor_create", actor_spec=spec)
         else:
@@ -1165,7 +1443,7 @@ class NodeService:
             # not be overwritten in the directory.)
             spec = st["spec"]
             if (spec.creation_return_id
-                    and self._lookup_object(spec.creation_return_id) is None):
+                    and not self._object_exists(spec.creation_return_id)):
                 self._fail_returns(self._creation_task_spec(spec),
                                    exceptions.ActorDiedError(actor_id, reason))
             # fail everything still queued
@@ -1216,9 +1494,9 @@ class NodeService:
         if target == self.node_id or target is None:
             self._local_cancel(task_id, force)
         else:
-            svc = self._service_of(target)
-            if svc is not None:
-                svc.post_remote(("remote_cancel", task_id, force))
+            peer = self._peer(target)
+            if peer is not None:
+                peer.post_remote(("remote_cancel", task_id, force))
 
     def _local_cancel(self, task_id: TaskID, force: bool) -> None:
         rec = self._waiting_deps.pop(task_id, None)
@@ -1249,7 +1527,7 @@ class NodeService:
         waiter = _Waiter(req_id=req_id, conn_key=conn_key,
                          object_ids=object_ids)
         for oid in object_ids:
-            if self._lookup_object(oid) is None:
+            if not self._object_exists(oid):
                 waiter.remaining.add(oid)
         if not waiter.remaining:
             self._fire_get(waiter)
@@ -1309,7 +1587,7 @@ class NodeService:
         waiter = _Waiter(req_id=req_id, conn_key=conn_key,
                          object_ids=object_ids, num_returns=num_returns)
         for oid in object_ids:
-            if self._lookup_object(oid) is None:
+            if not self._object_exists(oid):
                 waiter.remaining.add(oid)
         ready = len(object_ids) - len(waiter.remaining)
         if ready >= num_returns or timeout == 0:
@@ -1423,14 +1701,15 @@ class NodeService:
         ok = True
         reserved = []
         for idx, (bundle, nid) in enumerate(zip(spec.bundles, assignment)):
-            svc = self._service_of(nid)
-            if svc is None or not svc.reserve_bundle((spec.pg_id, idx), bundle):
+            peer = self._peer(nid)
+            if peer is None or not peer.reserve_bundle((spec.pg_id, idx),
+                                                       bundle):
                 ok = False
                 break
-            reserved.append((svc, (spec.pg_id, idx)))
+            reserved.append((peer, (spec.pg_id, idx)))
         if not ok:
-            for svc, key in reserved:
-                svc.release_bundle(key)
+            for peer, key in reserved:
+                peer.release_bundle(key)
             self._reply(conn_key, P.INFO_REPLY, (req_id, None))
             return
         self.gcs.register_pg(spec, assignment)
@@ -1441,9 +1720,16 @@ class NodeService:
         if rec is None:
             return
         for idx, nid in enumerate(rec["assignment"]):
-            svc = self._service_of(nid)
-            if svc is not None:
-                svc.release_bundle((pg_id, idx))
+            peer = self._peer(nid)
+            if peer is not None:
+                peer.release_bundle((pg_id, idx))
+
+    def _peer_stats(self, info, what: str) -> Any:
+        """Stats from any alive node: in-process or over the wire."""
+        if info.service is not None:
+            return info.service.node_stats(what)
+        peer = self._peer(info.node_id)
+        return peer.node_stats(what) if peer is not None else None
 
     def _cluster_info(self, what: str) -> Any:
         if what == "resources_total":
@@ -1451,32 +1737,21 @@ class NodeService:
         if what == "resources_available":
             out: Dict[str, float] = {}
             for info in self.gcs.alive_nodes():
-                if info.service is not None:
-                    for k, v in info.service.available_snapshot().items():
-                        out[k] = out.get(k, 0.0) + v
+                avail = self._peer_stats(info, "available")
+                for k, v in (avail or {}).items():
+                    out[k] = out.get(k, 0.0) + v
             return out
         if what == "nodes":
             return [{"node_id": n.node_id, "address": n.address,
                      "resources": n.resources_total, "alive": n.alive,
                      "labels": n.labels}
-                    for n in self.gcs.nodes.values()]
+                    for n in self.gcs.nodes_snapshot()]
         if what == "store_stats":
             return self.store.stats()
         if what == "workers":
             out = []
             for info in self.gcs.alive_nodes():
-                svc = info.service
-                if svc is None:
-                    continue
-                for wid, w in svc._workers.items():
-                    out.append({
-                        "worker_id": wid.hex(),
-                        "node_id": info.node_id.hex(),
-                        "pid": w.proc.pid if w.proc else None,
-                        "state": w.state,
-                        "actor_id": (w.actor_id.hex()
-                                     if w.actor_id else None),
-                    })
+                out.extend(self._peer_stats(info, "workers") or [])
             return out
         if what == "config":
             return CONFIG.dump()
@@ -1491,15 +1766,15 @@ class NodeService:
                      "class_name": rec.spec.name,
                      "node_id": rec.node_id,
                      "num_restarts": rec.num_restarts}
-                    for aid, rec in self.gcs.actors.items()]
+                    for aid, rec in self.gcs.actors_snapshot()]
         if what == "objects":
             return [{"object_id": oid, "node_id": nid, "size": meta.size}
-                    for oid, (nid, meta) in self.gcs.directory.items()]
+                    for oid, (nid, meta) in self.gcs.directory_snapshot()]
         if what == "placement_groups":
             return [{"pg_id": pid, "state": rec["state"],
                      "bundles": rec["spec"].bundles,
                      "strategy": rec["spec"].strategy}
-                    for pid, rec in self.gcs.placement_groups.items()]
+                    for pid, rec in self.gcs.pgs_snapshot()]
         return None
 
     def _record_event(self, spec: P.TaskSpec, state: str) -> None:

@@ -24,7 +24,7 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from multiprocessing import shared_memory, resource_tracker
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .config import CONFIG
 from .ids import ObjectID
@@ -367,6 +367,66 @@ class ObjectStore:
                         os.unlink(e.spilled_path)
                     except OSError:
                         pass
+
+    # -------------------------------------------------- network transfer
+    def read_payload(self, object_id: ObjectID
+                     ) -> Optional[Tuple[ObjectMeta, Optional[bytes]]]:
+        """Raw wire bytes of an object, for cross-host pull (reference:
+        ``object_manager.h:117`` Push/Pull). Inline/error values travel
+        in the meta itself (payload None). The entry is pinned during the
+        copy so a concurrent spill can't unmap it."""
+        with self._lock:
+            e = self._touch(object_id)
+            if e is None:
+                return None
+            meta = e.meta
+            if meta.inline is not None or meta.error is not None:
+                return (meta, None)
+            e.pinned += 1
+        try:
+            if (meta.arena_ref is not None and self._arena is not None
+                    and meta.arena_ref[0] == self._arena.path):
+                data = bytes(self._arena.buffer(meta.arena_ref[1], meta.size))
+            elif meta.shm_name is not None:
+                seg = (e.segment if e.segment is not None
+                       else attach_segment(meta.shm_name))
+                try:
+                    data = bytes(seg.buf[:meta.size])
+                finally:
+                    if seg is not e.segment:
+                        seg.close()
+            else:
+                return None
+            return (meta, data)
+        finally:
+            self.unpin(object_id)
+
+    def adopt_payload(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
+        """Store a pulled copy of a remote object as a local secondary
+        copy (never published to the directory — the primary stays with
+        the owner). Only used cross-host, so the deterministic segment
+        name cannot collide with the owner's."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None and e.sealed:
+                return e.meta
+        size = len(data)
+        ref = self.alloc_in_arena(object_id, size)
+        if ref is not None:
+            self._arena.buffer(ref[1], size)[:] = data
+            meta = ObjectMeta(object_id=object_id, size=size, arena_ref=ref)
+        else:
+            # distinct name: never collides with the owner's segment when
+            # "cross-host" is simulated on one machine (RTPU_NODE_HOST)
+            seg = shared_memory.SharedMemory(
+                create=True, size=max(size, 1),
+                name=f"{_segment_name(object_id)}p{os.getpid() % 100000}")
+            seg.buf[:size] = data
+            name = seg.name
+            seg.close()
+            meta = ObjectMeta(object_id=object_id, size=size, shm_name=name)
+        self.adopt(meta)
+        return meta
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

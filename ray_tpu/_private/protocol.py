@@ -50,6 +50,22 @@ PUT_OBJECT_SYNC = 25    # (req_id, ObjectMeta) — acked once the store adopts i
 ALLOC_OBJECT = 26       # (req_id, ObjectID, size) — arena Create; reply
                         # INFO_REPLY (arena_path, offset) | None
 
+# node <-> node (network plane; reference analogues:
+# ``node_manager.proto:363`` RequestWorkerLease/forwarding and
+# ``object_manager.h:117`` Push/Pull)
+NODE_POST = 27          # item tuple, enqueued on the peer's event loop
+OBJ_GET_META = 28       # (req_id, ObjectID, pin) -> INFO_REPLY meta|None
+OBJ_UNPIN = 29          # ObjectID
+OBJ_PULL = 30           # (req_id, ObjectID) -> INFO_REPLY (meta, bytes)|None
+PG_RESERVE = 31         # (req_id, pg_key, demand) -> INFO_REPLY bool
+PG_RELEASE = 32         # pg_key
+NODE_STATS = 33         # (req_id, what) -> INFO_REPLY payload
+
+# client/node <-> GCS service (reference: ``gcs_service.proto:63-699``)
+GCS_CALL = 34           # (req_id, method, args, kwargs) -> INFO_REPLY
+GCS_CAST = 35           # (method, args, kwargs) — no reply (hot mutators)
+GCS_SUBSCRIBE = 36      # channel — pushes EVENT (channel, payload) frames
+
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
 GET_REPLY = 41          # (req_id, [ObjectMeta])
@@ -189,3 +205,28 @@ def connect_unix(path: str, timeout: float = 30.0) -> Connection:
     sock.connect(path)
     sock.settimeout(None)
     return Connection(sock)
+
+
+def connect_tcp(host: str, port: int, timeout: float = 30.0) -> Connection:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return Connection(sock)
+
+
+def connect_address(address: str, timeout: float = 30.0) -> Connection:
+    """Connect to ``host:port`` (TCP) or a filesystem path (unix)."""
+    if ":" in address and not address.startswith("/"):
+        host, port = address.rsplit(":", 1)
+        return connect_tcp(host, int(port), timeout)
+    return connect_unix(address, timeout)
+
+
+def listen_tcp(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
+    """Bound+listening TCP socket; port 0 picks a free port (read it back
+    via ``sock.getsockname()[1]``)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(128)
+    return sock

@@ -131,6 +131,57 @@ def cmd_timeline(client, args) -> None:
     print(f"wrote {out} (open in chrome://tracing or ui.perfetto.dev)")
 
 
+def cmd_start(args) -> None:
+    """Start a node process: ``rtpu start --head [--gcs-port N]`` or
+    ``rtpu start --address HOST:PORT`` (reference: ``ray start``,
+    ``python/ray/scripts/scripts.py``). Runs in the foreground unless
+    --daemon; kill with SIGTERM / ``rtpu stop``."""
+    import subprocess
+
+    from .._private import main as node_main
+
+    fwd = []
+    if args.head:
+        fwd += ["--head", "--gcs-port", str(args.gcs_port)]
+    else:
+        fwd += ["--address", args.address]
+    fwd += ["--node-port", str(args.node_port),
+            "--advertise-host", args.advertise_host]
+    if args.num_cpus is not None:
+        fwd += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        fwd += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        fwd += ["--resources", args.resources]
+    if args.daemon:
+        pid_file = args.pid_file or "/tmp/rtpu_node.pid"
+        proc = subprocess.Popen([sys.executable, "-m",
+                                 "ray_tpu._private.main"] + fwd,
+                                start_new_session=True)
+        with open(pid_file, "w") as f:
+            f.write(str(proc.pid))
+        print(f"node started pid={proc.pid} (pid file {pid_file})")
+        return
+    raise SystemExit(node_main.main(fwd))
+
+
+def cmd_stop(args) -> None:
+    import signal
+
+    pid_file = args.pid_file or "/tmp/rtpu_node.pid"
+    try:
+        with open(pid_file) as f:
+            pid = int(f.read().strip())
+    except OSError:
+        raise SystemExit(f"no pid file at {pid_file}")
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"sent SIGTERM to {pid}")
+    except ProcessLookupError:
+        print(f"process {pid} already gone")
+    os.unlink(pid_file)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="rtpu",
                                      description="ray_tpu cluster CLI")
@@ -149,7 +200,30 @@ def main(argv=None) -> None:
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("-o", "--output")
 
+    p_start = sub.add_parser("start", help="start a cluster node process")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", default=None)
+    p_start.add_argument("--gcs-port", type=int, default=6379)
+    p_start.add_argument("--node-port", type=int, default=0)
+    p_start.add_argument("--advertise-host", default="127.0.0.1",
+                         help="address other hosts reach this node at "
+                         "(set to this machine's network IP for "
+                         "multi-host clusters)")
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.add_argument("--num-tpus", type=float, default=None)
+    p_start.add_argument("--resources", default=None)
+    p_start.add_argument("--daemon", action="store_true")
+    p_start.add_argument("--pid-file", default=None)
+    p_stop = sub.add_parser("stop", help="stop a daemonized node")
+    p_stop.add_argument("--pid-file", default=None)
+
     args = parser.parse_args(argv)
+    if args.command == "start":
+        cmd_start(args)
+        return
+    if args.command == "stop":
+        cmd_stop(args)
+        return
     session = _find_session(args.session)
     client = _connect(session)
     try:
