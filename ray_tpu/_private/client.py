@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -40,6 +41,69 @@ class CoreClient:
         self._registered_fns: set = set()
         self._reader_thread: Optional[threading.Thread] = None
         self._closed = threading.Event()
+        # local reference counts per object; the node hears only the
+        # 0→1 / 1→0 edges (reference: ``reference_count.h:61``).
+        # ref_decr is called from ObjectRef.__del__, which cyclic GC may
+        # run at ANY point — including while this thread already holds
+        # _ref_lock — so decrements only append to a lock-free deque and
+        # are applied under the lock by ref_incr or the flusher thread.
+        # Edges are sent INSIDE the lock: a register and a drop can never
+        # reach the wire in inverted order.
+        self._ref_counts: Dict[ObjectID, int] = {}
+        self._ref_lock = threading.Lock()
+        self._pending_decrs: "deque[ObjectID]" = deque()
+        self._flusher: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ refcounts
+    def ref_incr(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            self._apply_decrs_locked()
+            n = self._ref_counts.get(oid, 0)
+            self._ref_counts[oid] = n + 1
+            if n == 0:
+                self._emit_edge(P.REF_REGISTER, oid)
+        self._ensure_flusher()
+
+    def ref_decr(self, oid: ObjectID) -> None:
+        # GC-safe: deque.append is atomic and takes no lock
+        self._pending_decrs.append(oid)
+
+    def _apply_decrs_locked(self) -> None:
+        while True:
+            try:
+                oid = self._pending_decrs.popleft()
+            except IndexError:
+                return
+            n = self._ref_counts.get(oid, 0) - 1
+            if n <= 0:
+                self._ref_counts.pop(oid, None)
+                self._emit_edge(P.REF_DROP, oid)
+            else:
+                self._ref_counts[oid] = n
+
+    def _emit_edge(self, op: int, oid: ObjectID) -> None:
+        if self._closed.is_set():
+            return
+        try:
+            self._send(op, oid)
+        except OSError:
+            pass
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is not None and self._flusher.is_alive():
+            return
+        t = threading.Thread(target=self._flush_loop,
+                             name="rtpu-ref-flusher", daemon=True)
+        self._flusher = t
+        t.start()
+
+    def _flush_loop(self) -> None:
+        while not self._closed.wait(0.2):
+            if self._pending_decrs:
+                with self._ref_lock:
+                    self._apply_decrs_locked()
+        with self._ref_lock:
+            self._apply_decrs_locked()
 
     def _active_namespace(self) -> str:
         """Task-context namespace if set (worker executing a task), else

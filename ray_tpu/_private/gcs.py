@@ -109,6 +109,28 @@ class GlobalControlPlane:
         self.directory: Dict[ObjectID, Tuple[NodeID, ObjectMeta]] = {}
         self.task_events: deque = deque(maxlen=CONFIG.task_events_buffer_size)
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
+        # distributed reference counting (reference: reference_count.h:61):
+        # holder = (node_id_bin, conn_key) — one entry per process holding
+        # at least one local ref; pins = in-flight submitted tasks using
+        # the object as an argument
+        self.ref_holders: Dict[ObjectID, set] = {}
+        self.ref_pins: Dict[ObjectID, int] = {}
+        self._task_arg_refs: Dict[TaskID, List[ObjectID]] = {}
+        self._task_pin_owner: Dict[TaskID, NodeID] = {}
+        # returns whose refs all died BEFORE the task sealed them: the
+        # seal must free them immediately (fire-and-forget tasks)
+        self._freed_early: set = set()
+        # lineage: creating TaskSpec per return object, for reconstruction
+        # (reference: object_recovery_manager.h:90), bounded by
+        # CONFIG.max_lineage_bytes
+        self.lineage: Dict[ObjectID, Any] = {}
+        self._lineage_live: Dict[TaskID, int] = {}   # live return oids/spec
+        self._lineage_bytes = 0
+        # reconstruction claims: only one node rebuilds a lost object, and
+        # only objects that were sealed at least once are "lost" (an
+        # in-flight first execution must never be duplicated)
+        self._sealed_once: set = set()
+        self._reconstruct_claims: Dict[ObjectID, float] = {}
 
     # ------------------------------------------------------------- nodes
     def register_node(self, info: NodeInfo) -> None:
@@ -122,6 +144,7 @@ class GlobalControlPlane:
 
     def remove_node(self, node_id: NodeID, reason: str = "") -> None:
         dead_actors: List[ActorID] = []
+        freed: List[Any] = []
         with self._lock:
             info = self.nodes.get(node_id)
             if info is None:
@@ -135,8 +158,15 @@ class GlobalControlPlane:
             for aid, rec in self.actors.items():
                 if rec.node_id == node_id and rec.state != ACTOR_DEAD:
                     dead_actors.append(aid)
+            # release arg pins whose submitting node can never unpin
+            orphans = [tid for tid, owner in self._task_pin_owner.items()
+                       if owner == node_id]
+            for tid in orphans:
+                self._unpin_locked(tid, freed)
         self.publish("NODE", {"node_id": node_id, "state": "DEAD",
                               "reason": reason})
+        for z in freed:
+            self.publish("REF_ZERO", z)
         for aid in dead_actors:
             self.set_actor_state(aid, ACTOR_DEAD,
                                  reason=f"node {node_id} died")
@@ -248,6 +278,16 @@ class GlobalControlPlane:
                          meta: ObjectMeta) -> None:
         with self._lock:
             self.directory[object_id] = (node_id, meta)
+            self._sealed_once.add(object_id)
+            self._reconstruct_claims.pop(object_id, None)
+            garbage = object_id in self._freed_early
+            if garbage:
+                self._freed_early.discard(object_id)
+        if garbage:
+            # every ref died before the value was sealed (fire-and-forget
+            # task): the fresh copy is garbage on arrival
+            self.publish("REF_ZERO", {"object_id": object_id,
+                                      "node_id": node_id})
 
     def lookup_location(
             self, object_id: ObjectID) -> Optional[Tuple[NodeID, ObjectMeta]]:
@@ -276,6 +316,139 @@ class GlobalControlPlane:
             if rec:
                 rec["state"] = PG_REMOVED
             return rec
+
+    # ------------------------------------------------- reference counting
+    def ref_register(self, oid: ObjectID, holder: tuple) -> None:
+        with self._lock:
+            self.ref_holders.setdefault(oid, set()).add(holder)
+
+    def ref_drop(self, oid: ObjectID, holder: tuple) -> None:
+        free = None
+        with self._lock:
+            holders = self.ref_holders.get(oid)
+            if holders is None:
+                return   # never tracked (or already freed): not ours
+            holders.discard(holder)
+            free = self._zero_check(oid)
+        if free is not None:
+            self.publish("REF_ZERO", free)
+
+    def drop_all_refs(self, holder: tuple, oids: List[ObjectID]) -> None:
+        """A holder process died/disconnected: drop everything it held."""
+        freed = []
+        with self._lock:
+            for oid in oids:
+                holders = self.ref_holders.get(oid)
+                if holders is None:
+                    continue
+                holders.discard(holder)
+                z = self._zero_check(oid)
+                if z is not None:
+                    freed.append(z)
+        for z in freed:
+            self.publish("REF_ZERO", z)
+
+    def pin_task_args(self, task_id: TaskID, oids: List[ObjectID],
+                      owner_node: Optional[NodeID] = None) -> None:
+        """Submitted-task references: args keep their objects alive for
+        the task's lifetime even if every Python ref dies meanwhile.
+        ``owner_node`` (the submitting node) lets ``remove_node`` release
+        pins whose owner can never send the unpin."""
+        with self._lock:
+            self._task_arg_refs[task_id] = list(oids)
+            if owner_node is not None:
+                self._task_pin_owner[task_id] = owner_node
+            for oid in oids:
+                self.ref_pins[oid] = self.ref_pins.get(oid, 0) + 1
+
+    def unpin_task_args(self, task_id: TaskID) -> None:
+        freed = []
+        with self._lock:
+            self._unpin_locked(task_id, freed)
+        for z in freed:
+            self.publish("REF_ZERO", z)
+
+    def _unpin_locked(self, task_id: TaskID, freed: list) -> None:
+        self._task_pin_owner.pop(task_id, None)
+        for oid in self._task_arg_refs.pop(task_id, ()):
+            n = self.ref_pins.get(oid, 1) - 1
+            if n <= 0:
+                self.ref_pins.pop(oid, None)
+            else:
+                self.ref_pins[oid] = n
+            z = self._zero_check(oid)
+            if z is not None:
+                freed.append(z)
+
+    def _zero_check(self, oid: ObjectID):
+        """Callers hold _lock. Returns a REF_ZERO payload when the object
+        became garbage: it was tracked, no process holds a ref, and no
+        in-flight task uses it."""
+        holders = self.ref_holders.get(oid)
+        if holders is None or holders or self.ref_pins.get(oid, 0) > 0:
+            return None
+        del self.ref_holders[oid]
+        spec = self.lineage.pop(oid, None)
+        if spec is not None:
+            # spec cost was charged once for all returns: release it when
+            # the last live return goes
+            live = self._lineage_live.get(spec.task_id, 1) - 1
+            if live <= 0:
+                self._lineage_live.pop(spec.task_id, None)
+                self._lineage_bytes -= self._spec_cost(spec)
+            else:
+                self._lineage_live[spec.task_id] = live
+        loc = self.directory.get(oid)
+        if loc is None:
+            # refs died before the task sealed its return: mark so the
+            # eventual seal frees the value instead of leaking it
+            self._freed_early.add(oid)
+        return {"object_id": oid,
+                "node_id": loc[0] if loc is not None else None}
+
+    # --------------------------------------------------------------- lineage
+    @staticmethod
+    def _spec_cost(spec) -> int:
+        cost = 256
+        for slot, val in list(spec.args) + list(spec.kwargs.values()):
+            if slot == "v":
+                cost += len(val)
+        return cost
+
+    def record_lineage(self, spec) -> None:
+        cost = self._spec_cost(spec)
+        with self._lock:
+            if spec.task_id in self._lineage_live:
+                return   # resubmission of a recorded task
+            if self._lineage_bytes + cost > CONFIG.max_lineage_bytes:
+                return   # over budget: this object won't be reconstructable
+            for oid in spec.return_ids:
+                self.lineage[oid] = spec
+            self._lineage_live[spec.task_id] = len(spec.return_ids)
+            self._lineage_bytes += cost
+
+    def get_lineage(self, oid: ObjectID):
+        with self._lock:
+            return self.lineage.get(oid)
+
+    def claim_lineage(self, oid: ObjectID,
+                      claim_timeout_s: float = 60.0):
+        """Atomic reconstruction claim: returns the creating TaskSpec only
+        if the object is genuinely LOST — sealed at least once, currently
+        locationless — and nobody else claimed it recently. One winner
+        per loss; an in-flight first execution is never duplicated."""
+        with self._lock:
+            if oid in self.directory or oid not in self._sealed_once:
+                return None
+            spec = self.lineage.get(oid)
+            if spec is None:
+                return None
+            now = time.monotonic()
+            t = self._reconstruct_claims.get(oid)
+            if t is not None and now - t < claim_timeout_s:
+                return None
+            self._reconstruct_claims[oid] = now
+            return spec
 
     # --------------------------------------------------------- snapshots
     # Explicit copies for state queries: both the in-process plane and the

@@ -36,6 +36,8 @@ _ALLOWED = frozenset({
     "lookup_location", "drop_location", "register_pg", "get_pg",
     "remove_pg", "record_task_event", "list_task_events", "publish",
     "actors_snapshot", "directory_snapshot", "pgs_snapshot",
+    "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
+    "unpin_task_args", "record_lineage", "get_lineage", "claim_lineage",
 })
 
 
@@ -189,6 +191,8 @@ class RemoteControlPlane:
     _CASTS = frozenset({
         "heartbeat", "publish_location", "drop_location",
         "record_task_event", "publish", "kv_del", "finish_job",
+        "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
+        "unpin_task_args", "record_lineage",
     })
 
     def __init__(self, address: str):

@@ -274,6 +274,13 @@ class NodeService:
         self.host = os.environ.get("RTPU_NODE_HOST") or socket.gethostname()
         self._peers: Dict[NodeID, _RemotePeer] = {}
 
+        # reference counting: objects each client connection holds (edge
+        # transitions forwarded to the control plane), and in-flight
+        # lineage reconstructions (reference: reference_count.h:61 +
+        # object_recovery_manager.h:90)
+        self._conn_refs: Dict[int, Set[ObjectID]] = {}
+        self._reconstructing: Set[ObjectID] = set()
+
         self._rng = random.Random(self.node_id.binary())
 
     # ----------------------------------------------------------- lifecycle
@@ -301,6 +308,7 @@ class NodeService:
         self.gcs.subscribe("NODE", self._on_node_event)
         self.gcs.subscribe("TASK_FINISHED", self._on_task_finished)
         self.gcs.subscribe("ACTOR", self._on_actor_event)
+        self.gcs.subscribe("REF_ZERO", self._on_ref_zero)
         t_acc = threading.Thread(target=self._accept_loop,
                                  args=(self._listener,),
                                  name=f"rtpu-accept-{self.node_id.hex()[:6]}",
@@ -538,7 +546,15 @@ class NodeService:
         elif kind == "node_dead":
             self._on_node_dead(item[1])
         elif kind == "task_finished":
-            self._owned.pop(item[1], None)
+            owned = self._owned.pop(item[1], None)
+            if owned is not None:
+                # we were the submitter: release the task's arg pins
+                try:
+                    self.gcs.unpin_task_args(item[1])
+                except Exception:
+                    pass
+        elif kind == "ref_zero":
+            self._local_ref_zero(item[1], item[2])
         elif kind == "actor_dead":
             self._on_remote_actor_dead(item[1], item[2])
         elif kind == "timer":
@@ -640,6 +656,22 @@ class NodeService:
             req_id, what, filters = payload
             self._reply(key, P.INFO_REPLY,
                         (req_id, self._state_query(what, filters)))
+        elif op == P.REF_REGISTER:
+            refs = self._conn_refs.setdefault(key, set())
+            if payload not in refs:
+                refs.add(payload)
+                try:
+                    self.gcs.ref_register(payload, self._holder_id(key))
+                except Exception:
+                    pass
+        elif op == P.REF_DROP:
+            refs = self._conn_refs.get(key)
+            if refs is not None and payload in refs:
+                refs.discard(payload)
+                try:
+                    self.gcs.ref_drop(payload, self._holder_id(key))
+                except Exception:
+                    pass
 
     def _reply(self, conn_key: int, op: int, payload: Any) -> None:
         conn = self._conns.get(conn_key)
@@ -695,9 +727,30 @@ class NodeService:
             return None
         return peer.store if isinstance(peer, NodeService) else peer
 
+    @staticmethod
+    def _arg_refs(spec: P.TaskSpec) -> List[ObjectID]:
+        return [val for slot, val in
+                list(spec.args) + list(spec.kwargs.values()) if slot == "r"]
+
+    def _pin_submission(self, task_id: TaskID, arg_refs: List[ObjectID],
+                        spec: Optional[P.TaskSpec] = None) -> None:
+        """Submitted-task references + lineage recording at submission
+        (reference: reference_count.h submitted-task refs;
+        task lineage, ``task_manager.h:369``). Pins carry this node as
+        owner so the control plane can release them if we die."""
+        try:
+            if arg_refs:
+                self.gcs.pin_task_args(task_id, arg_refs,
+                                       owner_node=self.node_id)
+            if spec is not None and spec.function_id:
+                self.gcs.record_lineage(spec)
+        except Exception:
+            pass
+
     def _submit_task(self, spec: P.TaskSpec) -> None:
         self._owned[spec.task_id] = _OwnedTask(
             spec=spec, kind="task", retries_left=spec.max_retries)
+        self._pin_submission(spec.task_id, self._arg_refs(spec), spec)
         self._route_task(spec)
 
     def _route_task(self, spec: P.TaskSpec) -> None:
@@ -764,6 +817,7 @@ class NodeService:
         else:
             rec.remaining_deps.add(oid)
             self._dep_index.setdefault(oid, set()).add(rec.spec.task_id)
+            self._maybe_reconstruct(oid)
 
     def _pin_deps(self, rec: "_TaskRecord") -> None:
         """Pin every dependency at its *owning* store just before dispatch,
@@ -796,6 +850,55 @@ class NodeService:
         if loc is None:
             return None
         return self._peer_store(loc[0])
+
+    # ------------------------------------------ refcount + reconstruction
+    def _holder_id(self, conn_key: int) -> tuple:
+        return (self.node_id.binary(), conn_key)
+
+    def _on_ref_zero(self, payload) -> None:
+        self._events.put(("ref_zero", payload["object_id"],
+                          payload["node_id"]))
+
+    def _local_ref_zero(self, oid: ObjectID,
+                        owner_node: Optional[NodeID]) -> None:
+        """No process holds a reference and no task uses the object:
+        free our copy (primary or pulled secondary). Arena blocks whose
+        bytes were ever read go through the free-quarantine."""
+        if owner_node == self.node_id:
+            self.gcs.drop_location(oid)
+        if self.store.contains(oid):
+            self.store.free([oid])
+
+    def _maybe_reconstruct(self, oid: ObjectID) -> bool:
+        """Lost object with recorded lineage: resubmit its creating task
+        (reference: ``object_recovery_manager.h:90``). Returns True if a
+        reconstruction is (already) in flight. The control plane's
+        claim_lineage is the gate: it hands out the spec only when the
+        object was sealed once and is now locationless, to exactly one
+        claimant — so in-flight first executions and concurrent
+        reconstructions are never duplicated."""
+        if oid in self._reconstructing:
+            return True
+        if self.store.contains(oid):
+            return False
+        try:
+            spec = self.gcs.claim_lineage(oid)
+        except Exception:
+            return False
+        if spec is None:
+            return False
+        if spec.task_id in self._owned:
+            return True         # resubmission already in flight locally
+        self._reconstructing.update(spec.return_ids)
+        self._owned[spec.task_id] = _OwnedTask(
+            spec=spec, kind="task", retries_left=spec.max_retries)
+        self._pin_submission(spec.task_id, self._arg_refs(spec))
+        # creating-task args may themselves be lost: recurse
+        for dep in self._arg_refs(spec):
+            if not self._object_exists(dep):
+                self._maybe_reconstruct(dep)
+        self._route_task(spec)
+        return True
 
     def _object_exists(self, oid: ObjectID) -> bool:
         """Existence probe for wait()/readiness checks: metadata only,
@@ -1169,6 +1272,7 @@ class NodeService:
         self._events.put(("object_ready", oid, meta))
 
     def _on_object_ready(self, oid: ObjectID, meta: ObjectMeta) -> None:
+        self._reconstructing.discard(oid)
         # resolve task dependencies
         for tid in self._dep_index.pop(oid, ()):  # noqa: B020
             rec = self._waiting_deps.get(tid)
@@ -1217,6 +1321,8 @@ class NodeService:
         self._owned[ActorTaskIds.creation_task(spec)] = _OwnedTask(
             spec=self._creation_task_spec(spec), kind="actor_create",
             retries_left=0, actor_spec=spec)
+        self._pin_submission(ActorTaskIds.creation_task(spec),
+                             self._arg_refs(spec))
         strategy = spec.scheduling_strategy
         if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
             target = self._pg_target_node(strategy)
@@ -1303,6 +1409,7 @@ class NodeService:
     def _submit_actor_task(self, spec: P.TaskSpec) -> None:
         self._owned[spec.task_id] = _OwnedTask(
             spec=spec, kind="actor_call", retries_left=spec.max_retries)
+        self._pin_submission(spec.task_id, self._arg_refs(spec))
         rec = self.gcs.get_actor(spec.actor_id)
         if rec is None or rec.state == ACTOR_DEAD:
             self._fail_returns(spec, exceptions.ActorDiedError(
@@ -1529,6 +1636,7 @@ class NodeService:
         for oid in object_ids:
             if not self._object_exists(oid):
                 waiter.remaining.add(oid)
+                self._maybe_reconstruct(oid)
         if not waiter.remaining:
             self._fire_get(waiter)
             return
@@ -1589,6 +1697,7 @@ class NodeService:
         for oid in object_ids:
             if not self._object_exists(oid):
                 waiter.remaining.add(oid)
+                self._maybe_reconstruct(oid)
         ready = len(object_ids) - len(waiter.remaining)
         if ready >= num_returns or timeout == 0:
             self._fire_wait(waiter)
@@ -1625,6 +1734,13 @@ class NodeService:
         self._driver_conn_keys.discard(key)
         # arena Creates this connection never sealed are garbage now
         self.store.reclaim_unsealed(key)
+        # the process died with references: drop them all at once
+        held = self._conn_refs.pop(key, None)
+        if held:
+            try:
+                self.gcs.drop_all_refs(self._holder_id(key), list(held))
+            except Exception:
+                pass
         wid = self._conn_worker.pop(key, None)
         if wid is None:
             return
@@ -1672,7 +1788,14 @@ class NodeService:
 
     def _on_node_dead(self, node_id: NodeID) -> None:
         """Owner-side recovery: resubmit or fail tasks we forwarded to a node
-        that died (reference: lease failure + ``RetryTaskIfPossible``)."""
+        that died (reference: lease failure + ``RetryTaskIfPossible``), and
+        rebuild lost objects that local waiters/deps still need
+        (``object_recovery_manager.h:90``)."""
+        peer = self._peers.pop(node_id, None)
+        if peer is not None:
+            peer.close()
+        for oid in set(self._obj_waiter_index) | set(self._dep_index):
+            self._maybe_reconstruct(oid)   # claim gate filters non-lost
         for tid, owned in list(self._owned.items()):
             if owned.done or owned.assigned_node != node_id:
                 continue

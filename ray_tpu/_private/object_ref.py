@@ -12,10 +12,35 @@ from .ids import ObjectID, TaskID
 
 
 class ObjectRef:
-    __slots__ = ("id",)
+    """Distributed reference counting (reference: ``reference_count.h:61``
+    local references): every live ObjectRef instance counts toward its
+    process's local count for the object; the process tells its node on
+    the 0→1 and 1→0 transitions, and the control plane frees the object
+    when no process holds a reference and no submitted task uses it.
+    Unpickling a ref (task args, values containing refs) registers the
+    receiving process as a borrower automatically."""
 
-    def __init__(self, object_id: ObjectID):
+    __slots__ = ("id", "_tracked")
+
+    def __init__(self, object_id: ObjectID, _track: bool = True):
         self.id = object_id
+        self._tracked = False
+        if _track:
+            from . import context
+            client = context.current_client
+            if client is not None:
+                client.ref_incr(object_id)
+                self._tracked = True
+
+    def __del__(self):
+        if self._tracked:
+            try:
+                from . import context
+                client = context.current_client
+                if client is not None:
+                    client.ref_decr(self.id)
+            except Exception:   # interpreter teardown / closed conn
+                pass
 
     def binary(self) -> bytes:
         return self.id.binary()

@@ -66,6 +66,10 @@ GCS_CALL = 34           # (req_id, method, args, kwargs) -> INFO_REPLY
 GCS_CAST = 35           # (method, args, kwargs) — no reply (hot mutators)
 GCS_SUBSCRIBE = 36      # channel — pushes EVENT (channel, payload) frames
 
+# distributed reference counting (reference: ``reference_count.h:61``)
+REF_REGISTER = 37       # ObjectID — this client now holds a reference
+REF_DROP = 38           # ObjectID — this client's last local ref died
+
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
 GET_REPLY = 41          # (req_id, [ObjectMeta])
