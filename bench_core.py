@@ -1,0 +1,168 @@
+"""Core control-plane microbenchmarks (ray_perf port).
+
+Measures the runtime primitives with the SAME metric names the
+reference's harness publishes (``python/ray/_private/ray_perf.py:93-260``
+→ ``release/release_logs/2.7.0/microbenchmark.json``), so every row of
+BASELINE.md's single-node table is directly comparable.
+
+Prints one JSON line per metric:
+    {"metric", "value", "unit", "vs_baseline"}
+where vs_baseline = ours / reference (higher is better), then a summary
+line with the geometric mean. Run: ``python bench_core.py [--quick]``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+
+# BASELINE.md single-node numbers (reference release 2.7.0 microbenchmark)
+BASELINES = {
+    "single_client_tasks_sync": 1312.0,
+    "single_client_tasks_async": 10739.0,
+    "1_1_actor_calls_sync": 2256.0,
+    "1_1_actor_calls_async": 7615.0,
+    "1_1_actor_calls_concurrent": 4746.0,
+    "1_n_actor_calls_async": 10134.0,
+    "n_n_actor_calls_async": 30848.0,
+    "single_client_put_gigabytes": 18.0,
+    "single_client_get_object_containing_10k_refs": 14.8,
+    "single_client_wait_1k_refs": 5.5,
+}
+
+QUICK = "--quick" in sys.argv
+DURATION = 1.0 if QUICK else 3.0
+
+
+def timeit(name: str, fn, multiplier: int = 1, unit: str = "ops/s"):
+    fn()                                   # warmup
+    count = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < DURATION:
+        fn()
+        count += 1
+    dt = time.perf_counter() - t0
+    rate = count * multiplier / dt
+    base = BASELINES.get(name)
+    rec = {"metric": name, "value": round(rate, 2), "unit": unit,
+           "vs_baseline": round(rate / base, 3) if base else None}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+@ray_tpu.remote
+def tiny():
+    return b"ok"
+
+
+@ray_tpu.remote
+class Tiny:
+    def m(self):
+        return b"ok"
+
+
+def main():
+    # store sized so the put benchmark never crosses the spill threshold
+    ray_tpu.init(num_cpus=8, object_store_memory=4 << 30)
+    results = []
+
+    results.append(timeit(
+        "single_client_tasks_sync",
+        lambda: ray_tpu.get(tiny.remote())))
+
+    results.append(timeit(
+        "single_client_tasks_async",
+        lambda: ray_tpu.get([tiny.remote() for _ in range(100)]),
+        multiplier=100))
+
+    a = Tiny.remote()
+    ray_tpu.get(a.m.remote())
+    results.append(timeit(
+        "1_1_actor_calls_sync",
+        lambda: ray_tpu.get(a.m.remote())))
+
+    results.append(timeit(
+        "1_1_actor_calls_async",
+        lambda: ray_tpu.get([a.m.remote() for _ in range(100)]),
+        multiplier=100))
+
+    c = Tiny.options(max_concurrency=16).remote()
+    ray_tpu.get(c.m.remote())
+    results.append(timeit(
+        "1_1_actor_calls_concurrent",
+        lambda: ray_tpu.get([c.m.remote() for _ in range(100)]),
+        multiplier=100))
+
+    # zero-CPU actors: the pool must not exhaust the node's CPU slots
+    # (reference microbenchmark actors are scheduling-weightless too)
+    pool = [Tiny.options(num_cpus=0).remote() for _ in range(8)]
+    ray_tpu.get([x.m.remote() for x in pool], timeout=60)
+    results.append(timeit(
+        "1_n_actor_calls_async",
+        lambda: ray_tpu.get([x.m.remote() for x in pool
+                             for _ in range(12)]),
+        multiplier=12 * len(pool)))
+
+    # n submitting threads, n actors (reference: n drivers)
+    def n_n_round():
+        def drive(actor):
+            ray_tpu.get([actor.m.remote() for _ in range(25)])
+        threads = [threading.Thread(target=drive, args=(x,)) for x in pool]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    results.append(timeit("n_n_actor_calls_async", n_n_round,
+                          multiplier=25 * len(pool)))
+
+    data = np.zeros(128 << 20, dtype=np.uint8)   # 128 MiB
+
+    def put_round():
+        refs = [ray_tpu.put(data) for _ in range(4)]
+        ray_tpu.free(refs)      # immediate free: keep the store unspilled
+
+    put_round()                                  # warmup
+    count = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < DURATION:
+        put_round()
+        count += 1
+    dt = time.perf_counter() - t0
+    gib = count * 4 * 128 / 1024 / dt
+    rec = {"metric": "single_client_put_gigabytes",
+           "value": round(gib, 3), "unit": "GiB/s",
+           "vs_baseline": round(
+               gib / BASELINES["single_client_put_gigabytes"], 3)}
+    print(json.dumps(rec), flush=True)
+    results.append(rec)
+
+    refs_10k = [ray_tpu.put(i) for i in range(10_000)]
+    box = ray_tpu.put(refs_10k)
+    results.append(timeit(
+        "single_client_get_object_containing_10k_refs",
+        lambda: ray_tpu.get(box)))
+
+    refs_1k = [ray_tpu.put(i) for i in range(1_000)]
+    results.append(timeit(
+        "single_client_wait_1k_refs",
+        lambda: ray_tpu.wait(refs_1k, num_returns=1000, timeout=30)))
+
+    scored = [x for x in results if x.get("vs_baseline")]
+    geo = float(np.exp(np.mean([np.log(x["vs_baseline"]) for x in scored])))
+    print(json.dumps({
+        "metric": "core_microbenchmark_geomean_vs_reference",
+        "value": round(geo, 3), "unit": "x",
+        "vs_baseline": round(geo, 3),
+        "detail": {x["metric"]: x["vs_baseline"] for x in scored},
+    }), flush=True)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,11 @@ class CoreClient:
         self._ref_counts: Dict[ObjectID, int] = {}
         self._ref_lock = threading.Lock()
         self._pending_decrs: "deque[ObjectID]" = deque()
+        # ordered edge stream, coalesced into one REF_BATCH frame — one
+        # socket write per ~batch of submissions instead of one per ref.
+        # Delayed registration is safe: an object only becomes freeable
+        # once tracked, and tracking starts when the batch lands.
+        self._edge_buf: List[Tuple[int, ObjectID]] = []
         self._flusher: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ refcounts
@@ -61,7 +66,9 @@ class CoreClient:
             n = self._ref_counts.get(oid, 0)
             self._ref_counts[oid] = n + 1
             if n == 0:
-                self._emit_edge(P.REF_REGISTER, oid)
+                self._edge_buf.append((P.REF_REGISTER, oid))
+            if len(self._edge_buf) >= 256:
+                self._flush_edges_locked()
         self._ensure_flusher()
 
     def ref_decr(self, oid: ObjectID) -> None:
@@ -77,17 +84,29 @@ class CoreClient:
             n = self._ref_counts.get(oid, 0) - 1
             if n <= 0:
                 self._ref_counts.pop(oid, None)
-                self._emit_edge(P.REF_DROP, oid)
+                self._edge_buf.append((P.REF_DROP, oid))
             else:
                 self._ref_counts[oid] = n
 
-    def _emit_edge(self, op: int, oid: ObjectID) -> None:
-        if self._closed.is_set():
+    def _flush_edges_locked(self) -> None:
+        if not self._edge_buf or self._closed.is_set():
+            self._edge_buf.clear()
             return
+        batch, self._edge_buf = self._edge_buf, []
         try:
-            self._send(op, oid)
+            self._send(P.REF_BATCH, batch)
         except OSError:
             pass
+
+    def flush_refs(self) -> None:
+        """Synchronously emit buffered ref edges. Called at ordering
+        boundaries: a worker flushes BEFORE sending TASK_DONE so borrows
+        registered during execution land while the task's arg pins still
+        hold; a driver flushes after get() so refs unpickled out of a
+        returned value are registered promptly."""
+        with self._ref_lock:
+            self._apply_decrs_locked()
+            self._flush_edges_locked()
 
     def _ensure_flusher(self) -> None:
         if self._flusher is not None and self._flusher.is_alive():
@@ -99,11 +118,13 @@ class CoreClient:
 
     def _flush_loop(self) -> None:
         while not self._closed.wait(0.2):
-            if self._pending_decrs:
+            if self._pending_decrs or self._edge_buf:
                 with self._ref_lock:
                     self._apply_decrs_locked()
+                    self._flush_edges_locked()
         with self._ref_lock:
             self._apply_decrs_locked()
+            self._flush_edges_locked()
 
     def _active_namespace(self) -> str:
         """Task-context namespace if set (worker executing a task), else
@@ -263,6 +284,7 @@ class CoreClient:
         out = []
         for ref, m in zip(refs, metas):
             out.append(self._load_meta(ref, m, timeout))
+        self.flush_refs()   # register refs unpickled from the values
         return out
 
     def _load_meta(self, ref: ObjectRef, meta: ObjectMeta,

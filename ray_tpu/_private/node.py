@@ -332,6 +332,17 @@ class NodeService:
                                   daemon=True)
         t_tick.start()
         self._threads += [t_acc, t_disp, t_tick]
+        # warm pool: spawning lazily on the first task burst serializes
+        # behind worker cold-start (reference prestarts too,
+        # ``worker_pool.h`` PrestartWorkers)
+        n_pre = (CONFIG.num_prestart_workers
+                 or int(self.resources_total.get("CPU", 0)))
+        n_pre = max(0, min(n_pre, self._max_workers,
+                           # leave startup-concurrency headroom so a
+                           # runtime-env spawn isn't stuck behind the wave
+                           CONFIG.maximum_startup_concurrency - 2))
+        for _ in range(n_pre):
+            self._spawn_worker()
 
     def stop(self, kill_workers: bool = True) -> None:
         if self._stopped.is_set():
@@ -438,10 +449,14 @@ class NodeService:
     # plane and bundle reservation are thread-safe (store RLock /
     # _res_lock) and MUST NOT wait on the dispatcher: peer A's
     # dispatcher may be blocked on a request to B while B's is blocked
-    # on a request to A.
+    # on a request to A. Puts (alloc/seal) are also served here so a
+    # 100MB memcpy-heavy put stream never queues behind task dispatch —
+    # the same separation the reference gets from plasma being its own
+    # process.
     _DIRECT_OPS = frozenset({P.NODE_POST, P.OBJ_GET_META, P.OBJ_UNPIN,
                              P.OBJ_PULL, P.PG_RESERVE, P.PG_RELEASE,
-                             P.NODE_STATS})
+                             P.NODE_STATS, P.ALLOC_OBJECT, P.PUT_OBJECT,
+                             P.PUT_OBJECT_SYNC})
 
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
@@ -455,14 +470,20 @@ class NodeService:
                 except Exception:
                     import traceback
                     traceback.print_exc(file=sys.stderr)
-                    # request-type ops carry (req_id, ...): answer None
-                    # so the caller doesn't block out its full timeout
+                    # request-type ops carry (req_id, ...): answer so the
+                    # caller doesn't block forever / out its full timeout
                     op, payload = msg
                     if op in (P.OBJ_GET_META, P.OBJ_PULL, P.PG_RESERVE,
-                              P.NODE_STATS) and isinstance(payload, tuple):
+                              P.NODE_STATS,
+                              P.ALLOC_OBJECT) and isinstance(payload, tuple):
                         result = False if op == P.PG_RESERVE else None
                         self._reply(key, P.INFO_REPLY,
                                     (payload[0], result))
+                    elif (op == P.PUT_OBJECT_SYNC
+                          and isinstance(payload, tuple)):
+                        err = to_bytes(RuntimeError(
+                            "put failed on the node store"))
+                        self._reply(key, P.ERROR_REPLY, (payload[0], err))
             else:
                 self._events.put(("msg", key, msg))
 
@@ -489,6 +510,23 @@ class NodeService:
         elif op == P.NODE_STATS:
             req_id, what = payload
             self._reply(key, P.INFO_REPLY, (req_id, self.node_stats(what)))
+        elif op == P.ALLOC_OBJECT:
+            req_id, oid, size = payload
+            try:
+                ref = self.store.alloc_in_arena(oid, size, writer_tag=key)
+            except Exception:   # noqa: BLE001 — client blocks on a reply
+                ref = None
+            self._reply(key, P.INFO_REPLY, (req_id, ref))
+        elif op == P.PUT_OBJECT:
+            self._seal_object(payload)
+        elif op == P.PUT_OBJECT_SYNC:
+            req_id, meta = payload
+            try:
+                self._seal_object(meta)
+            except Exception as e:  # noqa: BLE001 — client put() blocks
+                self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
+            else:
+                self._reply(key, P.PUT_REPLY, (req_id,))
 
     def node_stats(self, what: str) -> Any:
         """Cross-thread node introspection (also served to peers)."""
@@ -586,23 +624,6 @@ class NodeService:
             self._create_actor(payload)
         elif op == P.SUBMIT_ACTOR_TASK:
             self._submit_actor_task(payload)
-        elif op == P.PUT_OBJECT:
-            self._seal_object(payload)
-        elif op == P.ALLOC_OBJECT:
-            req_id, oid, size = payload
-            try:
-                ref = self.store.alloc_in_arena(oid, size, writer_tag=key)
-            except Exception:   # noqa: BLE001 — client blocks on a reply
-                ref = None
-            self._reply(key, P.INFO_REPLY, (req_id, ref))
-        elif op == P.PUT_OBJECT_SYNC:
-            req_id, meta = payload
-            try:
-                self._seal_object(meta)
-            except Exception as e:  # noqa: BLE001 — client put() is blocking
-                self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
-            else:
-                self._reply(key, P.PUT_REPLY, (req_id,))
         elif op == P.GET_OBJECTS:
             self._get_objects(key, *payload)
         elif op == P.WAIT_OBJECTS:
@@ -657,21 +678,12 @@ class NodeService:
             self._reply(key, P.INFO_REPLY,
                         (req_id, self._state_query(what, filters)))
         elif op == P.REF_REGISTER:
-            refs = self._conn_refs.setdefault(key, set())
-            if payload not in refs:
-                refs.add(payload)
-                try:
-                    self.gcs.ref_register(payload, self._holder_id(key))
-                except Exception:
-                    pass
+            self._apply_ref_edge(key, op, payload)
         elif op == P.REF_DROP:
-            refs = self._conn_refs.get(key)
-            if refs is not None and payload in refs:
-                refs.discard(payload)
-                try:
-                    self.gcs.ref_drop(payload, self._holder_id(key))
-                except Exception:
-                    pass
+            self._apply_ref_edge(key, op, payload)
+        elif op == P.REF_BATCH:
+            for edge_op, oid in payload:
+                self._apply_ref_edge(key, edge_op, oid)
 
     def _reply(self, conn_key: int, op: int, payload: Any) -> None:
         conn = self._conns.get(conn_key)
@@ -855,6 +867,19 @@ class NodeService:
     def _holder_id(self, conn_key: int) -> tuple:
         return (self.node_id.binary(), conn_key)
 
+    def _apply_ref_edge(self, key: int, op: int, oid: ObjectID) -> None:
+        refs = self._conn_refs.setdefault(key, set())
+        try:
+            if op == P.REF_REGISTER:
+                if oid not in refs:
+                    refs.add(oid)
+                    self.gcs.ref_register(oid, self._holder_id(key))
+            elif oid in refs:
+                refs.discard(oid)
+                self.gcs.ref_drop(oid, self._holder_id(key))
+        except Exception:
+            pass
+
     def _on_ref_zero(self, payload) -> None:
         self._events.put(("ref_zero", payload["object_id"],
                           payload["node_id"]))
@@ -952,14 +977,29 @@ class NodeService:
             return
         remaining = deque()
         failed_envs: Set[str] = set()
+        # once a (pg, resource-shape) fails to acquire, every later task
+        # with the same shape fails too — skip them instead of rescanning
+        # (keeps dispatch O(pending) per event, not O(pending²) per batch)
+        failed_shapes: Set[tuple] = set()
+        starved_envs: Set[str] = set()
         while self._pending:
             rec = self._pending.popleft()
             if rec.cancelled:
                 continue
+            shape = (rec.pg_key,
+                     tuple(sorted(rec.spec.resources.items())))
+            if shape in failed_shapes:
+                remaining.append(rec)
+                continue
             if not self._try_acquire(rec):
+                failed_shapes.add(shape)
                 remaining.append(rec)
                 continue
             env_key = self._rec_env_key(rec)
+            if env_key in starved_envs:
+                self._release_charge(rec)
+                remaining.append(rec)
+                continue
             wid = self._acquire_worker(env_key)
             if wid is None:
                 self._release_charge(rec)
@@ -976,6 +1016,7 @@ class NodeService:
                         + self._env_spawn_error.get(env_key, "<no log>")))
                     continue
                 remaining.append(rec)
+                starved_envs.add(env_key)
                 self._maybe_spawn_worker(rec)
                 # a different-env task behind this one may still have an
                 # idle worker; keep scanning instead of breaking

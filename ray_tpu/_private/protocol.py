@@ -69,6 +69,7 @@ GCS_SUBSCRIBE = 36      # channel — pushes EVENT (channel, payload) frames
 # distributed reference counting (reference: ``reference_count.h:61``)
 REF_REGISTER = 37       # ObjectID — this client now holds a reference
 REF_DROP = 38           # ObjectID — this client's last local ref died
+REF_BATCH = 39          # [(op, ObjectID), ...] — coalesced edge stream
 
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)

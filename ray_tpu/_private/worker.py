@@ -224,6 +224,9 @@ class WorkerRuntime:
                     return
             for oid, value in zip(spec.return_ids, values):
                 metas.append(self._store_return(oid, value))
+        # borrows registered during execution must land BEFORE the
+        # node unpins this task's args (same conn => ordered frames)
+        self.client.flush_refs()
         self.conn.send((P.TASK_DONE, (spec.task_id, metas, err_bytes, kind)))
 
     def _store_return(self, oid: ObjectID, value: Any) -> ObjectMeta:
