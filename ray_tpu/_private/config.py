@@ -51,6 +51,11 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                 "freed arena blocks whose object was ever read "
                                 "are quarantined this long before reuse "
                                 "(readers may hold zero-copy views)"),
+    # --- autoscaling ---
+    "infeasible_task_grace_s": (float, 0.0,
+                                "park tasks/actors with no feasible node this "
+                                "long (autoscaler scale-up window) instead of "
+                                "failing immediately; 0 = fail fast"),
     # --- health / failure ---
     "health_check_period_ms": (int, 3000,
                                "control-plane liveness ping period "

@@ -53,6 +53,9 @@ class NodeInfo:
     # availability reported with heartbeats (RaySyncer-equivalent resource
     # gossip for nodes the scheduler can't snapshot in-process)
     resources_available: Dict[str, float] = field(default_factory=dict)
+    # queued resource demand reported with heartbeats (autoscaler input;
+    # reference: ResourceDemandScheduler's load report)
+    pending_shapes: List[Dict[str, float]] = field(default_factory=list)
 
     def __getstate__(self):
         # the live service object never crosses the wire
@@ -176,7 +179,8 @@ class GlobalControlPlane:
             return [n for n in self.nodes.values() if n.alive]
 
     def heartbeat(self, node_id: NodeID,
-                  resources_available: Optional[Dict[str, float]] = None
+                  resources_available: Optional[Dict[str, float]] = None,
+                  pending_shapes: Optional[List[Dict[str, float]]] = None
                   ) -> None:
         with self._lock:
             info = self.nodes.get(node_id)
@@ -184,6 +188,8 @@ class GlobalControlPlane:
                 info.last_heartbeat = time.monotonic()
                 if resources_available is not None:
                     info.resources_available = resources_available
+                if pending_shapes is not None:
+                    info.pending_shapes = pending_shapes
 
     def get_node(self, node_id: NodeID) -> Optional[NodeInfo]:
         with self._lock:

@@ -281,6 +281,14 @@ class NodeService:
         self._conn_refs: Dict[int, Set[ObjectID]] = {}
         self._reconstructing: Set[ObjectID] = set()
 
+        # tasks/actors with no feasible node, parked while the
+        # autoscaler adds capacity (reference: infeasible task queue,
+        # ``cluster_task_manager.cc``); (deadline, kind, spec)
+        self._infeasible: List[tuple] = []
+        # set while re-routing a parked item so a repeat park keeps the
+        # ORIGINAL deadline (the grace window must not reset under churn)
+        self._repark_deadline: Optional[float] = None
+
         self._rng = random.Random(self.node_id.binary())
 
     # ----------------------------------------------------------- lifecycle
@@ -433,7 +441,8 @@ class NodeService:
             # (health period × threshold), and a healthy node must not be
             # declared dead because one transfer is slow.
             try:
-                self.gcs.heartbeat(self.node_id, self.available_snapshot())
+                self.gcs.heartbeat(self.node_id, self.available_snapshot(),
+                                   pending_shapes=self.pending_demand())
             except Exception:
                 pass
             self._events.put(("timer", self._on_tick))
@@ -441,9 +450,69 @@ class NodeService:
     def _on_tick(self) -> None:
         self._reap_startup_failures()
         self._reap_idle_workers()
+        self._retry_infeasible()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
         self._dispatch()
+
+    def _park_infeasible(self, kind: str, spec) -> bool:
+        """Queue work with no feasible node while the autoscaler adds
+        capacity; False when fail-fast semantics apply (grace 0)."""
+        grace = CONFIG.infeasible_task_grace_s
+        if grace <= 0:
+            return False
+        deadline = (self._repark_deadline if self._repark_deadline
+                    is not None else time.monotonic() + grace)
+        self._infeasible.append((deadline, kind, spec))
+        return True
+
+    def _fail_actor_infeasible(self, spec: P.ActorSpec) -> None:
+        self.gcs.set_actor_state(spec.actor_id, ACTOR_DEAD,
+                                 reason="no feasible node")
+        if spec.creation_return_id:
+            err = to_bytes(exceptions.ActorDiedError(
+                spec.actor_id, "no feasible node for actor resources"))
+            self._seal_object(ObjectMeta(
+                object_id=spec.creation_return_id, size=len(err),
+                error=err))
+
+    def _retry_infeasible(self) -> None:
+        if not self._infeasible:
+            return
+        parked, self._infeasible = self._infeasible, []
+        now = time.monotonic()
+        for deadline, kind, spec in parked:
+            if self._probe_target(spec) is not None:
+                # keep the original deadline if routing re-parks (the
+                # cluster changed between probe and route)
+                self._repark_deadline = deadline
+                try:
+                    if kind == "task":
+                        self._route_task(spec)
+                    else:
+                        self._route_actor(spec)
+                finally:
+                    self._repark_deadline = None
+            elif now < deadline:
+                self._infeasible.append((deadline, kind, spec))
+            elif kind == "task":
+                self._fail_returns(spec, RuntimeError(
+                    f"no feasible node for resources {spec.resources} "
+                    f"within {CONFIG.infeasible_task_grace_s}s"))
+            else:
+                self._fail_actor_infeasible(spec)
+
+    def pending_demand(self) -> List[Dict[str, float]]:
+        """Queued-but-unplaced resource shapes (autoscaler input)."""
+        shapes: List[Dict[str, float]] = []
+        try:
+            for rec in list(self._pending)[:100]:
+                shapes.append(dict(rec.spec.resources))
+            for _, kind, spec in list(self._infeasible)[:100]:
+                shapes.append(dict(spec.resources))
+        except RuntimeError:   # racy snapshot from the tick thread
+            pass
+        return shapes
 
     # Ops answered inline on the connection-reader thread. The object
     # plane and bundle reservation are thread-safe (store RLock /
@@ -775,9 +844,9 @@ class NodeService:
                                      self._rng)
         owned = self._owned.get(spec.task_id)
         if target is None:
-            # Infeasible now; retry when cluster membership changes.
-            self._fail_returns(spec, RuntimeError(
-                f"no feasible node for resources {spec.resources}"))
+            if not self._park_infeasible("task", spec):
+                self._fail_returns(spec, RuntimeError(
+                    f"no feasible node for resources {spec.resources}"))
             return
         if owned:
             owned.assigned_node = target
@@ -999,6 +1068,10 @@ class NodeService:
             if env_key in starved_envs:
                 self._release_charge(rec)
                 remaining.append(rec)
+                # skip the idle-deque rescan but still request a spawn —
+                # cold-start ramp must stay parallel up to the startup
+                # concurrency cap, not one worker per dispatch pass
+                self._maybe_spawn_worker(rec)
                 continue
             wid = self._acquire_worker(env_key)
             if wid is None:
@@ -1364,22 +1437,21 @@ class NodeService:
             retries_left=0, actor_spec=spec)
         self._pin_submission(ActorTaskIds.creation_task(spec),
                              self._arg_refs(spec))
+        self._route_actor(spec)
+
+    def _probe_target(self, spec) -> Optional[NodeID]:
+        """Where this spec would schedule right now (None = infeasible)."""
         strategy = spec.scheduling_strategy
         if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
-            target = self._pg_target_node(strategy)
-        else:
-            target = sched.pick_node(spec.resources, strategy or sched.DEFAULT,
-                                     self._candidates(), self.node_id,
-                                     self._rng)
+            return self._pg_target_node(strategy)
+        return sched.pick_node(spec.resources, strategy or sched.DEFAULT,
+                               self._candidates(), self.node_id, self._rng)
+
+    def _route_actor(self, spec: P.ActorSpec) -> None:
+        target = self._probe_target(spec)
         if target is None:
-            self.gcs.set_actor_state(spec.actor_id, ACTOR_DEAD,
-                                     reason="no feasible node")
-            if spec.creation_return_id:
-                err = to_bytes(exceptions.ActorDiedError(
-                    spec.actor_id, "no feasible node for actor resources"))
-                self._seal_object(ObjectMeta(
-                    object_id=spec.creation_return_id, size=len(err),
-                    error=err))
+            if not self._park_infeasible("actor", spec):
+                self._fail_actor_infeasible(spec)
             return
         self.gcs.set_actor_state(spec.actor_id, ACTOR_PENDING, node_id=target)
         if target == self.node_id:
@@ -1823,6 +1895,9 @@ class NodeService:
     def _on_node_event(self, payload) -> None:
         if payload.get("state") == "DEAD" and payload["node_id"] != self.node_id:
             self._events.put(("node_dead", payload["node_id"]))
+        elif payload.get("state") == "ALIVE" and self._infeasible:
+            # fresh capacity (autoscaler scale-up): retry parked work
+            self._events.put(("timer", self._retry_infeasible))
 
     def _on_task_finished(self, payload) -> None:
         self._events.put(("task_finished", payload["task_id"]))

@@ -1,0 +1,98 @@
+"""Autoscaler: demand-driven scale-up, idle drain.
+
+Reference analogues: ``autoscaler/_private/autoscaler.py:171`` +
+``fake_multi_node/node_provider.py:237``; tests modeled on
+``python/ray/tests/test_autoscaler_fake_multinode.py``.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalerConfig, FakeNodeProvider,
+                                NodeType, StandardAutoscaler)
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def autoscaling_cluster():
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster,
+                 _system_config={"infeasible_task_grace_s": 120.0})
+    provider = FakeNodeProvider(cluster)
+    config = AutoscalerConfig(
+        node_types={
+            "tpu_worker": NodeType(resources={"CPU": 4.0, "TPU": 4.0},
+                                   min_workers=0, max_workers=5),
+        },
+        idle_timeout_s=3.0,
+        update_interval_s=0.4,
+    )
+    scaler = StandardAutoscaler(cluster.gcs, provider, config)
+    scaler.start()
+    yield cluster, provider, scaler
+    scaler.stop()
+    ray_tpu.shutdown()
+    from ray_tpu._private.config import CONFIG
+    CONFIG.reload()
+    cluster.shutdown()
+
+
+def _alive_nodes(cluster):
+    return [n for n in cluster.gcs.alive_nodes()]
+
+
+def test_scale_up_then_idle_drain(autoscaling_cluster):
+    cluster, provider, scaler = autoscaling_cluster
+
+    @ray_tpu.remote(num_cpus=0, resources={"TPU": 1.0})
+    def tpu_task(i):
+        time.sleep(0.3)
+        return i
+
+    # 20 queued TPU-demand tasks; the head has no TPU -> must scale up
+    refs = [tpu_task.remote(i) for i in range(20)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert sorted(out) == list(range(20))
+    assert scaler.num_launched >= 1
+    assert len(provider.non_terminated_nodes()) >= 1
+
+    # demand gone: autoscaled nodes drain after the idle cooldown
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if not provider.non_terminated_nodes():
+            break
+        time.sleep(0.3)
+    assert not provider.non_terminated_nodes(), "idle nodes never drained"
+    assert scaler.num_terminated == scaler.num_launched
+    assert len(_alive_nodes(cluster)) == 1        # the head survives
+
+
+def test_scale_up_respects_max_workers(autoscaling_cluster):
+    cluster, provider, scaler = autoscaling_cluster
+
+    @ray_tpu.remote(num_cpus=0, resources={"TPU": 4.0})
+    def big(i):
+        time.sleep(0.5)
+        return i
+
+    # 40 whole-node shapes, but max_workers=5 caps the fleet
+    refs = [big.remote(i) for i in range(40)]
+    deadline = time.monotonic() + 30
+    peak = 0
+    while time.monotonic() < deadline:
+        peak = max(peak, len(provider.non_terminated_nodes()))
+        time.sleep(0.2)
+    assert 1 <= peak <= 5
+    assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(40))
+
+
+def test_min_workers_kept_warm(autoscaling_cluster):
+    cluster, provider, scaler = autoscaling_cluster
+    scaler.config.node_types["tpu_worker"].min_workers = 1
+    provider.create_node("tpu_worker", {"CPU": 4.0, "TPU": 4.0}, {})
+    time.sleep(scaler.config.idle_timeout_s + 2.0)
+    # idle well past the timeout, but min_workers floors the pool
+    assert len(provider.non_terminated_nodes()) == 1
