@@ -173,8 +173,23 @@ class CoreClient:
             fut = self._futures.pop(req_id, None)
             if fut is not None:
                 fut.set_exception(ser.from_bytes(err))
+        elif op == P.EVENT:
+            channel, data = payload
+            if channel == "LOG" and self.kind == P.KIND_DRIVER:
+                self._print_remote_logs(data)
         elif op == P.SHUTDOWN:
             self._fail_all(ConnectionError("node shutting down"))
+
+    @staticmethod
+    def _print_remote_logs(data: dict) -> None:
+        """Worker output on the driver's stdout, prefixed like the
+        reference's ``(pid=..., ip=...)`` log prefixes."""
+        import sys as _sys
+        prefix = f"(worker {data.get('worker', '?')[:8]} " \
+                 f"node={data.get('node_id', '?')[:8]})"
+        out = "".join(f"{prefix} {line}\n" for line in data.get("lines", ()))
+        _sys.stdout.write(out)
+        _sys.stdout.flush()
 
     def _fail_all(self, exc: Exception) -> None:
         # _req_lock orders this against _request: a request registered

@@ -41,6 +41,8 @@ def main(argv=None) -> int:
     ap.add_argument("--labels", default="{}")
     ap.add_argument("--session-dir", default=None)
     ap.add_argument("--ready-file", default=None)
+    ap.add_argument("--job-port", type=int, default=0,
+                    help="head only: REST port for job submission (0 = auto)")
     args = ap.parse_args(argv)
 
     if bool(args.head) == bool(args.address):
@@ -74,14 +76,28 @@ def main(argv=None) -> int:
     node = NodeService(gcs, session_dir, resources)
     node.start(labels=json.loads(args.labels), tcp_port=args.node_port,
                advertise_host=args.advertise_host)
+    job_rest = None
+    job_port = None
     if args.head:
         # drivers attaching by GCS address find the head node here
         gcs.kv_put(b"__rtpu_head_node",
                    json.dumps({"node_id": node.node_id.hex(),
                                "address": node.tcp_address}).encode())
+        # job submission API (reference: dashboard job head)
+        from ..job.http_server import JobRestServer
+        from ..job.manager import JobManager
+        manager = JobManager(
+            gcs, cluster_address=f"{args.advertise_host}:{gcs_port}",
+            session_dir=session_dir)
+        job_rest = JobRestServer(manager, port=args.job_port)
+        job_rest.start()
+        job_port = job_rest.port
+        gcs.kv_put(b"__rtpu_job_api",
+                   f"{args.advertise_host}:{job_port}".encode())
 
     ready = {"node_id": node.node_id.hex(), "gcs_port": gcs_port,
-             "node_address": node.tcp_address, "session_dir": session_dir}
+             "node_address": node.tcp_address, "session_dir": session_dir,
+             "job_port": job_port}
     line = json.dumps(ready)
     if args.ready_file:
         tmp = args.ready_file + ".tmp"
@@ -104,6 +120,8 @@ def main(argv=None) -> int:
                 break
     finally:
         node.stop()
+        if job_rest is not None:
+            job_rest.stop()
         if gcs_server is not None:
             gcs_server.stop()
     return 0

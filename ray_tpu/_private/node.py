@@ -317,6 +317,13 @@ class NodeService:
         self.gcs.subscribe("TASK_FINISHED", self._on_task_finished)
         self.gcs.subscribe("ACTOR", self._on_actor_event)
         self.gcs.subscribe("REF_ZERO", self._on_ref_zero)
+        self.gcs.subscribe("LOG", self._on_log_event)
+        if CONFIG.log_to_driver:
+            t_logs = threading.Thread(
+                target=self._log_tail_loop,
+                name=f"rtpu-logs-{self.node_id.hex()[:6]}", daemon=True)
+            t_logs.start()
+            self._threads.append(t_logs)
         t_acc = threading.Thread(target=self._accept_loop,
                                  args=(self._listener,),
                                  name=f"rtpu-accept-{self.node_id.hex()[:6]}",
@@ -433,6 +440,57 @@ class NodeService:
             t = threading.Thread(target=self._reader_loop, args=(key, conn),
                                  daemon=True)
             t.start()
+
+    # --------------------------------------------------------- log streaming
+    def _log_tail_loop(self) -> None:
+        """Tail THIS node's workers' logs and publish new lines
+        cluster-wide (reference: ``python/ray/_private/log_monitor.py:103``).
+        Every node forwards LOG events to its locally-connected drivers,
+        so a ``print()`` in any remote task shows up on the driver's
+        stdout. Only our own workers are tailed — in-process clusters
+        share one session dir, and K nodes each tailing it would print
+        every line K times (and replay history on scale-up)."""
+        offsets: Dict[str, int] = {}
+        while not self._stopped.wait(0.25):
+            paths = {w.log_path for w in list(self._workers.values())
+                     if w.log_path}
+            # keep tailing files we've seen: a worker's last lines often
+            # land right as it is reaped from self._workers
+            paths |= set(offsets)
+            for path in paths:
+                try:
+                    size = os.path.getsize(path)
+                    off = offsets.get(path, 0)
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        data = f.read(min(size - off, 1 << 20))
+                except OSError:
+                    continue
+                # consume only whole lines; a read landing mid-write
+                # leaves the partial tail for the next poll
+                consumed = data.rfind(b"\n") + 1
+                if consumed == 0:
+                    continue
+                offsets[path] = off + consumed
+                lines = data[:consumed].decode("utf-8", "replace"
+                                               ).splitlines()
+                worker = os.path.basename(path)[len("worker-"):-len(".log")]
+                for i in range(0, len(lines), 200):
+                    try:
+                        self.gcs.publish("LOG", {
+                            "node_id": self.node_id.hex()[:12],
+                            "worker": worker,
+                            "lines": lines[i:i + 200],
+                        })
+                    except Exception:
+                        break
+
+    def _on_log_event(self, payload) -> None:
+        """Forward worker log lines to locally-connected drivers."""
+        for key in list(self._driver_conn_keys):
+            self._reply(key, P.EVENT, ("LOG", payload))
 
     def _tick_loop(self) -> None:
         while not self._stopped.wait(1.0):
@@ -1301,6 +1359,9 @@ class NodeService:
         out = open(log_path, "ab")
         env = dict(os.environ)
         env["RTPU_WORKER"] = "1"
+        # stdout lands in the worker log file; unbuffered so the log
+        # tailer streams prints to the driver as they happen
+        env["PYTHONUNBUFFERED"] = "1"
         # Workers never grab the TPU; the driver owns device compute. Also
         # disable TPU-attach hooks in sitecustomize (saves ~2s/spawn).
         env["JAX_PLATFORMS"] = "cpu"

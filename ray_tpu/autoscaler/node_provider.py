@@ -56,10 +56,12 @@ class FakeNodeProvider(NodeProvider):
         return rec
 
     def terminate_node(self, handle: Any) -> None:
+        # remove from the cluster FIRST: if that raises, the handle stays
+        # tracked and the autoscaler retries next update
+        self._cluster.remove_node(handle["node"], allow_graceful=True)
         with self._lock:
             if handle in self._nodes:
                 self._nodes.remove(handle)
-        self._cluster.remove_node(handle["node"], allow_graceful=True)
 
     def non_terminated_nodes(self) -> List[Any]:
         with self._lock:

@@ -34,8 +34,10 @@ class RemoteNode:
 
     def __init__(self, proc: subprocess.Popen, ready: dict):
         self.proc = proc
+        self.ready = ready            # full readiness record (ports etc.)
         self.node_id_hex: str = ready["node_id"]
         self.address: str = ready["node_address"]
+        self.job_port = ready.get("job_port")
 
     @property
     def pid(self) -> int:

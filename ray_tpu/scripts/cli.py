@@ -182,6 +182,60 @@ def cmd_stop(args) -> None:
     os.unlink(pid_file)
 
 
+def _job_client(args):
+    from ..job.client import JobSubmissionClient
+    address = getattr(args, "job_address", None)
+    if not address and getattr(args, "address", None):
+        # resolve the REST endpoint through the cluster's GCS
+        from .._private.gcs_service import RemoteControlPlane
+        gcs = RemoteControlPlane(args.address)
+        try:
+            raw = gcs.kv_get(b"__rtpu_job_api")
+        finally:
+            gcs.close()
+        if raw is None:
+            raise SystemExit("cluster has no job API (head not started "
+                             "with a job server?)")
+        address = raw.decode()
+    if not address:
+        raise SystemExit("pass --address (cluster GCS) or --job-address")
+    return JobSubmissionClient(address)
+
+
+def cmd_submit(args) -> None:
+    client = _job_client(args)
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    if args.env:
+        runtime_env["env_vars"] = dict(kv.split("=", 1) for kv in args.env)
+    job_id = client.submit_job(
+        entrypoint=" ".join(args.entrypoint),
+        runtime_env=runtime_env or None,
+        submission_id=args.submission_id)
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        return
+    rec = client.wait_until_finished(job_id, timeout=args.timeout)
+    sys.stdout.write(client.get_job_logs(job_id))
+    print(f"job {job_id} {rec['status']} (rc={rec.get('return_code')})")
+    if rec["status"] != "SUCCEEDED":
+        raise SystemExit(1)
+
+
+def cmd_job(args) -> None:
+    client = _job_client(args)
+    if args.job_command == "status":
+        print(json.dumps(client.get_job_status(args.job_id), indent=2))
+    elif args.job_command == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.job_command == "stop":
+        print(json.dumps({"stopped": client.stop_job(args.job_id)}))
+    elif args.job_command == "list":
+        _print_table(client.list_jobs(),
+                     ["job_id", "status", "entrypoint", "return_code"])
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="rtpu",
                                      description="ray_tpu cluster CLI")
@@ -217,12 +271,46 @@ def main(argv=None) -> None:
     p_stop = sub.add_parser("stop", help="stop a daemonized node")
     p_stop.add_argument("--pid-file", default=None)
 
+    p_sub = sub.add_parser("submit", help="submit a job to a cluster")
+    p_sub.add_argument("--address", default=None,
+                       help="cluster GCS host:port")
+    p_sub.add_argument("--job-address", default=None,
+                       help="job REST host:port (skips GCS lookup)")
+    p_sub.add_argument("--working-dir", default=None)
+    p_sub.add_argument("--env", action="append", default=[],
+                       metavar="KEY=VALUE")
+    p_sub.add_argument("--submission-id", default=None)
+    p_sub.add_argument("--no-wait", action="store_true")
+    p_sub.add_argument("--timeout", type=float, default=600.0)
+    p_sub.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                       help="command to run (prefix with --)")
+
+    p_job = sub.add_parser("job", help="job status/logs/stop/list")
+    p_job.add_argument("job_command",
+                       choices=("status", "logs", "stop", "list"))
+    p_job.add_argument("job_id", nargs="?", default=None)
+    p_job.add_argument("--address", default=None)
+    p_job.add_argument("--job-address", default=None)
+    p_job.set_defaults(needs_job_id=("status", "logs", "stop"))
+
     args = parser.parse_args(argv)
     if args.command == "start":
         cmd_start(args)
         return
     if args.command == "stop":
         cmd_stop(args)
+        return
+    if args.command == "submit":
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]
+        if not args.entrypoint:
+            raise SystemExit("no entrypoint given (rtpu submit ... -- cmd)")
+        cmd_submit(args)
+        return
+    if args.command == "job":
+        if args.job_command in args.needs_job_id and not args.job_id:
+            raise SystemExit(f"rtpu job {args.job_command} needs a job id")
+        cmd_job(args)
         return
     session = _find_session(args.session)
     client = _connect(session)
