@@ -1,0 +1,89 @@
+"""RL benchmark: PPO learner samples/sec/chip (BASELINE.json north-star
+metric name) + IMPALA end-to-end sampling throughput.
+
+Prints one JSON line per metric. The reference publishes no number for
+this metric (BASELINE.json ``published: {}``), so ``vs_baseline`` is
+null — the value itself is the record the next round compares against.
+Run: ``python bench_rl.py [--quick]``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+import ray_tpu
+from ray_tpu.rl import CartPoleEnv, ImpalaConfig, PPOConfig
+
+QUICK = "--quick" in sys.argv
+
+
+def bench_ppo_learner() -> None:
+    """Learner-side SGD throughput: env steps consumed per second per
+    chip (reference metric: RLlib learner ``num_env_steps_trained``
+    throughput)."""
+    algo = (PPOConfig()
+            .environment(CartPoleEnv)
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=1024)
+            .training(num_sgd_iter=8, sgd_minibatch_size=512)
+            .build())
+    iters = 2 if QUICK else 5
+    algo.train()                               # warm compile + workers
+    t0 = time.perf_counter()
+    steps_trained = 0
+    for _ in range(iters):
+        result = algo.train()
+        # each sampled step is consumed num_sgd_iter times by the learner
+        steps_trained += (result["num_env_steps_sampled"]
+                          * algo.config.num_sgd_iter)
+    dt = time.perf_counter() - t0
+    algo.stop()
+    n_dev = len(jax.devices())
+    print(json.dumps({
+        "metric": "ppo_learner_samples_per_sec_per_chip",
+        "value": round(steps_trained / dt / n_dev, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": None,
+        "detail": {"n_devices": n_dev,
+                   "backend": jax.default_backend(),
+                   "env_steps_sampled_per_sec":
+                       round(steps_trained / algo.config.num_sgd_iter / dt,
+                             1)},
+    }), flush=True)
+
+
+def bench_impala_throughput() -> None:
+    algo = (ImpalaConfig()
+            .environment(CartPoleEnv)
+            .rollouts(num_rollout_workers=4, rollout_fragment_length=512)
+            .training(num_sgd_iter=1)
+            .build())
+    iters = 4 if QUICK else 12
+    algo.train()
+    t0 = time.perf_counter()
+    sampled = 0
+    for _ in range(iters):
+        sampled += algo.train()["num_env_steps_sampled"]
+    dt = time.perf_counter() - t0
+    algo.stop()
+    print(json.dumps({
+        "metric": "impala_env_steps_per_sec",
+        "value": round(sampled / dt, 1),
+        "unit": "steps/s",
+        "vs_baseline": None,
+        "detail": {"num_rollout_workers": 4},
+    }), flush=True)
+
+
+def main():
+    ray_tpu.init(num_cpus=8)
+    bench_ppo_learner()
+    bench_impala_throughput()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ Built-in envs avoid a gym dependency (CartPole dynamics are 20 lines).
 """
 
 from .env import CartPoleEnv, RandomEnv  # noqa: F401
+from .impala import Impala, ImpalaConfig  # noqa: F401
 from .learner import Learner, LearnerGroup  # noqa: F401
 from .module import DiscretePolicyModule  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401

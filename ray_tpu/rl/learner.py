@@ -29,16 +29,25 @@ class Learner:
                  *, lr: float = 3e-4, clip: float = 0.2,
                  vf_coeff: float = 0.5, entropy_coeff: float = 0.0,
                  grad_clip: float = 0.5, seed: int = 0,
+                 gamma: float = 0.99,
+                 rho_clip: float = 1.0, c_clip: float = 1.0,
+                 loss: str = "ppo",
                  loss_fn: Optional[Callable] = None):
         self.module = module
         self.clip = clip
         self.vf_coeff = vf_coeff
         self.entropy_coeff = entropy_coeff
+        self.gamma = gamma
+        self.rho_clip = rho_clip
+        self.c_clip = c_clip
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(grad_clip), optax.adam(lr))
         self.params = module.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
-        self._loss_fn = loss_fn or self._ppo_loss
+        # `loss` is a picklable name so LearnerGroup actors can build the
+        # same learner remotely; `loss_fn` overrides with a callable
+        builtin = {"ppo": self._ppo_loss, "vtrace": self._vtrace_loss}
+        self._loss_fn = loss_fn or builtin[loss]
         self._update = jax.jit(self._update_impl)
 
     # --------------------------------------------------------------- losses
@@ -60,6 +69,55 @@ class Learner:
         stats = {"pg_loss": pg_loss, "vf_loss": vf_loss,
                  "entropy": entropy, "total_loss": loss,
                  "approx_kl": (batch[SB.LOGP] - logp).mean()}
+        return loss, stats
+
+    def _vtrace_loss(self, params, batch) -> Tuple[jax.Array, Dict]:
+        """IMPALA's V-trace off-policy actor-critic loss over time-major
+        fragments (reference: ``rllib/algorithms/impala`` + the V-trace
+        targets of Espeholt et al. 2018). Batch layout: obs (B,T,D),
+        actions/rewards/dones/action_logp (B,T), bootstrap_obs (B,D).
+        The backward recursion is a ``lax.scan`` over time — one compiled
+        program, no Python loop."""
+        obs = batch[SB.OBS]
+        bsz, horizon = obs.shape[0], obs.shape[1]
+        logits, values = self.module.forward(
+            params, obs.reshape(bsz * horizon, -1))
+        logits = logits.reshape(bsz, horizon, -1)
+        values = values.reshape(bsz, horizon)
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[SB.ACTIONS]
+        tlogp = jnp.take_along_axis(logp_all, actions[..., None],
+                                    axis=-1)[..., 0]
+        rho = jnp.exp(tlogp - batch[SB.LOGP])
+        rho_c = jnp.minimum(rho, self.rho_clip)
+        cs = jnp.minimum(rho, self.c_clip)
+        _, bootstrap = self.module.forward(params, batch["bootstrap_obs"])
+        discounts = self.gamma * (1.0 - batch[SB.DONES].astype(jnp.float32))
+        values_tp1 = jnp.concatenate(
+            [values[:, 1:], bootstrap[:, None]], axis=1)
+        rewards = batch[SB.REWARDS]
+        deltas = rho_c * (rewards + discounts * values_tp1 - values)
+
+        def backward(acc, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        _, acc_rev = jax.lax.scan(
+            backward, jnp.zeros(bsz),
+            (deltas.T[::-1], discounts.T[::-1], cs.T[::-1]))
+        vs = values + acc_rev[::-1].T                       # (B,T)
+        vs_tp1 = jnp.concatenate([vs[:, 1:], bootstrap[:, None]], axis=1)
+        pg_adv = jax.lax.stop_gradient(
+            rho_c * (rewards + discounts * vs_tp1 - values))
+        pg_loss = -(tlogp * pg_adv).mean()
+        vf_loss = 0.5 * ((jax.lax.stop_gradient(vs) - values) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        loss = (pg_loss + self.vf_coeff * vf_loss
+                - self.entropy_coeff * entropy)
+        stats = {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                 "entropy": entropy, "total_loss": loss,
+                 "mean_rho": rho.mean()}
         return loss, stats
 
     # --------------------------------------------------------------- update
@@ -127,19 +185,29 @@ class LearnerGroup:
 
     def update(self, batch: SB.SampleBatch) -> Dict[str, float]:
         from .. import get
-        n = len(self._actors)
+        b = len(batch)
+        # never hand a learner an empty slice: with fewer rows than
+        # learners (async algorithms often deliver a single fragment)
+        # only the first len(batch) actors participate this round
+        parts = self._actors[:max(1, min(len(self._actors), b))]
+        n = len(parts)
         if n == 1:
-            return get(self._actors[0].update.remote(dict(batch)))
-        size = len(batch) // n
-        refs = [a.update.remote(dict(batch.slice(i * size,
-                                                 (i + 1) * size)))
-                for i, a in enumerate(self._actors)]
-        stats = get(refs)
-        # average weights across learners (data-parallel consensus)
-        weights = get([a.get_weights.remote() for a in self._actors])
-        mean_w = jax.tree_util.tree_map(
-            lambda *ws: np.mean(np.stack(ws), axis=0), *weights)
-        get([a.set_weights.remote(mean_w) for a in self._actors])
+            stats = [get(parts[0].update.remote(dict(batch)))]
+        else:
+            size = b // n
+            refs = []
+            for i, a in enumerate(parts):
+                hi = b if i == n - 1 else (i + 1) * size
+                refs.append(a.update.remote(dict(batch.slice(i * size,
+                                                             hi))))
+            stats = get(refs)
+        if len(self._actors) > 1:
+            # data-parallel consensus over the participants, broadcast
+            # to everyone (non-participants hold pre-update weights)
+            weights = get([a.get_weights.remote() for a in parts])
+            mean_w = jax.tree_util.tree_map(
+                lambda *ws: np.mean(np.stack(ws), axis=0), *weights)
+            get([a.set_weights.remote(mean_w) for a in self._actors])
         return {k: float(np.mean([s[k] for s in stats]))
                 for k in stats[0]}
 

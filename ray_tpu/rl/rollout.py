@@ -29,16 +29,28 @@ class RolloutWorker:
         self.gamma = gamma
         self.lam = lam
         self._rng = jax.random.PRNGKey(seed)
-        self._act = jax.jit(self.module.action_dist)
+
+        # rng split folded into the jitted call: one dispatch per env
+        # step instead of two (the sampling hot loop is dispatch-bound)
+        def _act_impl(params, obs, rng):
+            rng, key = jax.random.split(rng)
+            action, logp, value = self.module.action_dist(params, obs, key)
+            return action, logp, value, rng
+
+        self._act = jax.jit(_act_impl)
         self._value = jax.jit(
             lambda p, o: self.module.forward(p, o)[1])
         self._obs: Optional[np.ndarray] = None
         self._episode_reward = 0.0
         self._episode_rewards = []
 
-    def sample(self, weights, num_steps: int) -> Tuple[dict, dict]:
+    def sample(self, weights, num_steps: int,
+               compute_advantages: bool = True) -> Tuple[dict, dict]:
         """Collect num_steps transitions (episodes continue across
-        calls); returns (SampleBatch dict with GAE, stats)."""
+        calls); returns (SampleBatch dict, stats). With
+        ``compute_advantages`` the batch carries GAE columns (PPO);
+        off-policy consumers (V-trace) pass False and postprocess
+        learner-side."""
         import jax
         params = jax.tree_util.tree_map(jax.numpy.asarray, weights)
         if self._obs is None:
@@ -47,29 +59,31 @@ class RolloutWorker:
         obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
         logp_buf, vf_buf = [], []
         for _ in range(num_steps):
-            self._rng, key = jax.random.split(self._rng)
-            action, logp, value = self._act(
-                params, self._obs[None, :], key)
+            action, logp, value, self._rng = self._act(
+                params, self._obs[None, :], self._rng)
             a = int(action[0])
             next_obs, reward, terminated, truncated, _ = self.env.step(a)
             obs_buf.append(self._obs)
             act_buf.append(a)
             rew_buf.append(reward)
-            done_buf.append(terminated)
             logp_buf.append(float(logp[0]))
             vf_buf.append(float(value[0]))
             self._episode_reward += reward
             if terminated or truncated:
+                if truncated and not terminated:
+                    # episode CUT, not finished: fold the bootstrap into
+                    # the final reward so marking done stays unbiased —
+                    # otherwise the value stream leaks across the reset
+                    # into the next episode's fresh obs
+                    rew_buf[-1] += self.gamma * float(
+                        self._value(params, next_obs[None, :])[0])
+                done_buf.append(True)
                 self._episode_rewards.append(self._episode_reward)
                 self._obs, _ = self.env.reset()
                 self._episode_reward = 0.0
             else:
+                done_buf.append(False)
                 self._obs = next_obs
-        # bootstrap value for the unfinished tail
-        last_value = 0.0
-        if not (done_buf and done_buf[-1]):
-            last_value = float(self._value(params,
-                                           self._obs[None, :])[0])
         batch = SampleBatch({
             SB.OBS: np.asarray(obs_buf, np.float32),
             SB.ACTIONS: np.asarray(act_buf, np.int32),
@@ -78,12 +92,21 @@ class RolloutWorker:
             SB.LOGP: np.asarray(logp_buf, np.float32),
             SB.VF_PREDS: np.asarray(vf_buf, np.float32),
         })
-        batch = compute_gae(batch, gamma=self.gamma, lam=self.lam,
-                            last_value=last_value)
+        if compute_advantages:
+            # bootstrap value for the unfinished tail
+            last_value = 0.0
+            if not done_buf[-1]:
+                last_value = float(self._value(params,
+                                               self._obs[None, :])[0])
+            batch = compute_gae(batch, gamma=self.gamma, lam=self.lam,
+                                last_value=last_value)
         recent = self._episode_rewards[-20:]
         stats = {
             "episodes_total": len(self._episode_rewards),
             "episode_reward_mean": (float(np.mean(recent))
                                     if recent else float("nan")),
+            # obs following the last step: off-policy learners (V-trace)
+            # bootstrap from it with their CURRENT value function
+            "bootstrap_obs": np.asarray(self._obs, np.float32),
         }
         return dict(batch), stats
