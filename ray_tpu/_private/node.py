@@ -1531,6 +1531,15 @@ class NodeService:
                 return
             peer.post_remote(("remote_actor_create", spec))
 
+    def _fail_queued_actor_tasks(self, actor_id: ActorID,
+                                 reason: str) -> None:
+        """Fail every method call still queued for a dead actor."""
+        q = self._actor_queues.get(actor_id)
+        while q:
+            qspec = q.popleft()
+            self._fail_returns(qspec, exceptions.ActorDiedError(
+                actor_id, reason))
+
     def _creation_task_spec(self, spec: P.ActorSpec) -> P.TaskSpec:
         return P.TaskSpec(
             task_id=ActorTaskIds.creation_task(spec),
@@ -1562,6 +1571,10 @@ class NodeService:
             self._release_charge(rec)
             self.gcs.set_actor_state(spec.actor_id, ACTOR_DEAD,
                                      reason="creation task failed")
+            # method calls queued while the actor was PENDING would hang
+            # forever otherwise
+            self._fail_queued_actor_tasks(spec.actor_id,
+                                          "actor creation failed")
             w = self._workers.get(rec.worker_id)
             if w is not None:
                 w.actor_id = None
@@ -1727,12 +1740,7 @@ class NodeService:
                     and not self._object_exists(spec.creation_return_id)):
                 self._fail_returns(self._creation_task_spec(spec),
                                    exceptions.ActorDiedError(actor_id, reason))
-            # fail everything still queued
-            q = self._actor_queues.get(actor_id)
-            while q:
-                qspec = q.popleft()
-                self._fail_returns(qspec, exceptions.ActorDiedError(
-                    actor_id, reason))
+            self._fail_queued_actor_tasks(actor_id, reason)
 
     def _release_actor_charge(self, st: dict) -> None:
         """Return a live actor's resource charge to the pool it came from —

@@ -30,6 +30,19 @@ except Exception:  # pragma: no cover - cloudpickle ships with the image
 _HEADER = struct.Struct("<QQ")
 
 
+class _MainDetectingPickler(pickle.Pickler):
+    """C-speed pickler that flags global references into ``__main__``
+    (classes/functions pickled by reference that a worker process could
+    never import)."""
+
+    main_ref = False
+
+    def reducer_override(self, obj):
+        if getattr(obj, "__module__", None) == "__main__":
+            self.main_ref = True
+        return NotImplemented        # standard reduction continues
+
+
 def dumps_function(fn) -> bytes:
     """Pickle a function/class including closures (cloudpickle)."""
     return _function_pickler.dumps(fn)
@@ -44,12 +57,25 @@ def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
 
     Buffers are memoryviews into the original object's storage — the caller
     writes them into shm (or the socket) without an intermediate copy.
+
+    Plain pickle is the fast path, but it "succeeds" on
+    ``__main__``-defined classes/functions by pickling them BY REFERENCE,
+    which then fails to resolve in a worker whose ``__main__`` is the
+    worker module. A reducer_override hook detects actual global
+    references into ``__main__`` (no false positives on data that merely
+    CONTAINS the string) and redoes those — and anything plain pickle
+    rejects outright — with cloudpickle, which pickles by value.
     """
     buffers: List[pickle.PickleBuffer] = []
     try:
-        payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+        f = io.BytesIO()
+        pickler = _MainDetectingPickler(f, protocol=5,
+                                        buffer_callback=buffers.append)
+        pickler.dump(obj)
+        if pickler.main_ref:
+            raise pickle.PicklingError("__main__ reference")
+        payload = f.getvalue()
     except (pickle.PicklingError, AttributeError, TypeError):
-        # Fall back to cloudpickle for closures/locally-defined classes.
         buffers = []
         payload = _function_pickler.dumps(obj, protocol=5,
                                           buffer_callback=buffers.append)

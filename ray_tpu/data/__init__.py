@@ -10,7 +10,7 @@ prefetch (``Dataset.iter_device_batches``) feeding jax arrays straight
 onto the chips.
 """
 
-from .dataset import Dataset  # noqa: F401
+from .dataset import ActorPoolStrategy, Dataset  # noqa: F401
 from .read_api import (  # noqa: F401
     from_items,
     from_numpy,

@@ -1,14 +1,20 @@
-"""Dataset: lazy logical plan + streaming execution over block tasks.
+"""Dataset: lazy logical plan + streaming execution over block operators.
 
 Reference: ``python/ray/data/dataset.py:178`` (API surface),
 ``_internal/plan.py`` (logical plan), ``_internal/execution/
 streaming_executor.py:49`` (backpressure-aware streaming execution),
-``_internal/execution/operators/map_operator.py:39`` (fused map tasks).
+``_internal/execution/operators/map_operator.py:39`` (fused map tasks)
+and ``operators/actor_pool_map_operator.py`` (stateful UDFs on a
+reusable actor pool).
 
-Execution model here: row/batch transforms fuse into one remote task per
-block (one pass through the object store per stage-chain, like the
-reference's operator fusion); the driver keeps a bounded window of
-in-flight block tasks (backpressure) and yields blocks in order.
+Execution model: consecutive task transforms fuse into one remote task
+per block (one object-store pass per chain); a stage with
+``compute=ActorPoolStrategy(...)`` becomes its own operator running on
+a pool of long-lived actors (the UDF class is constructed once per
+actor, then reused for every block). Operators chain as generators,
+each holding a bounded in-flight window — the store's high-water mark
+stays at ~sum(windows) blocks regardless of dataset size, and consumed
+refs are freed by the distributed refcount as the consumer drops them.
 All-to-all ops (repartition / random_shuffle) are barriers that
 redistribute materialized block refs with slice/concat tasks.
 """
@@ -16,6 +22,7 @@ redistribute materialized block refs with slice/concat tasks.
 from __future__ import annotations
 
 import itertools
+from collections import deque
 from typing import (Any, Callable, Dict, Iterator, List, Optional,
                     Sequence, Tuple)
 
@@ -32,6 +39,22 @@ _DEFAULT_WINDOW = 8
 
 # A stage is ("map_batches"|"map"|"filter"|"flat_map", fn, kwargs)
 Stage = Tuple[str, Callable, dict]
+
+
+class ActorPoolStrategy:
+    """Run a stage's UDF on ``size`` long-lived actors (reference:
+    ``ActorPoolStrategy`` / ``actor_pool_map_operator.py``). Use with a
+    CLASS UDF whose construction is expensive (model weights, clients);
+    each actor constructs it once and maps every block it receives.
+    ``max_in_flight`` bounds queued blocks per actor (backpressure)."""
+
+    def __init__(self, size: int = 2, max_in_flight: int = 2,
+                 num_cpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None):
+        self.size = size
+        self.max_in_flight = max_in_flight
+        self.num_cpus = num_cpus
+        self.resources = resources
 
 
 def _apply_stages(blk: Block, stages: Sequence[Stage]) -> Block:
@@ -61,6 +84,19 @@ def _run_block_task(source_fn: Optional[Callable], source_block,
     blk = source_fn() if source_fn is not None else source_block
     blk = B.normalize_block(blk)
     return _apply_stages(blk, stages)
+
+
+@remote
+class _UDFActor:
+    """One pool member: constructs the user's class once, maps blocks."""
+
+    def __init__(self, ctor, args, kwargs, kind: str, stage_kw: dict):
+        self.fn = ctor(*(args or ()), **(kwargs or {}))
+        self.kind = kind
+        self.stage_kw = stage_kw
+
+    def call_block(self, blk: Block) -> Block:
+        return _apply_stages(blk, [(self.kind, self.fn, self.stage_kw)])
 
 
 @remote
@@ -98,9 +134,18 @@ class Dataset:
                        self._stages + [stage])
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    compute: Optional[ActorPoolStrategy] = None,
+                    fn_constructor_args: Optional[tuple] = None,
+                    fn_constructor_kwargs: Optional[dict] = None,
                     **kw) -> "Dataset":
-        return self._with_stage(("map_batches", fn,
-                                 {"batch_format": batch_format}))
+        """``fn`` is a callable (task stage) or, with
+        ``compute=ActorPoolStrategy(...)``, a class whose instances are
+        constructed once per pool actor and called per block."""
+        return self._with_stage(("map_batches", fn, {
+            "batch_format": batch_format, "compute": compute,
+            "fn_constructor_args": fn_constructor_args,
+            "fn_constructor_kwargs": fn_constructor_kwargs,
+        }))
 
     def map(self, fn: Callable) -> "Dataset":
         return self._with_stage(("map", fn, {}))
@@ -116,36 +161,116 @@ class Dataset:
         return len(self._sources if self._sources is not None
                    else self._block_refs or [])
 
+    def _segments(self) -> List[Tuple[str, Any]]:
+        """Fuse consecutive task stages; actor stages stand alone
+        (reference: operator fusion + ActorPoolMapOperator)."""
+        segs: List[Tuple[str, Any]] = []
+        for st in self._stages:
+            if st[2].get("compute") is not None:
+                segs.append(("actors", st))
+            elif segs and segs[-1][0] == "tasks":
+                segs[-1][1].append(st)
+            else:
+                segs.append(("tasks", [st]))
+        return segs
+
+    @staticmethod
+    def _task_operator(upstream: Iterator[Tuple[Optional[Callable], Any]],
+                       stages: List[Stage],
+                       window: int) -> Iterator[Any]:
+        """Fused map tasks with a bounded in-flight window: at most
+        ``window`` submitted-but-unconsumed blocks exist at this
+        operator (backpressure; reference: MapOperator + the streaming
+        executor's resource limits)."""
+        in_flight: "deque" = deque()
+        for src_fn, src_ref in upstream:
+            if len(in_flight) >= window:
+                yield in_flight.popleft()
+            in_flight.append(_run_block_task.remote(src_fn, src_ref,
+                                                    stages))
+        while in_flight:
+            yield in_flight.popleft()
+
+    @staticmethod
+    def _actor_operator(upstream: Iterator[Any],
+                        stage: Stage) -> Iterator[Any]:
+        """Map blocks over a pool of long-lived UDF actors; each actor
+        holds at most ``max_in_flight`` queued blocks (reference:
+        ``actor_pool_map_operator.py``)."""
+        from .. import kill
+        kind, ctor, kw = stage
+        compute: ActorPoolStrategy = kw["compute"]
+        stage_kw = {k: v for k, v in kw.items()
+                    if k not in ("compute", "fn_constructor_args",
+                                 "fn_constructor_kwargs")}
+        opts: Dict[str, Any] = {}
+        if compute.num_cpus is not None:
+            opts["num_cpus"] = compute.num_cpus
+        if compute.resources:
+            opts["resources"] = compute.resources
+        pool = [_UDFActor.options(**opts).remote(
+            ctor, kw.get("fn_constructor_args"),
+            kw.get("fn_constructor_kwargs"), kind, stage_kw)
+            for _ in range(compute.size)]
+        try:
+            rr = itertools.cycle(pool)
+            cap = compute.size * compute.max_in_flight
+            in_flight: "deque" = deque()
+            for ref in upstream:
+                if len(in_flight) >= cap:
+                    yield in_flight.popleft()
+                in_flight.append(next(rr).call_block.remote(ref))
+            while in_flight:
+                # drain waits for completion: the finally kills the pool
+                # the moment the consumer exhausts us, and a killed actor
+                # fails its queued calls. Actors process FIFO, so the
+                # last call per actor completing implies all earlier
+                # yielded refs completed too.
+                head = in_flight.popleft()
+                wait([head], num_returns=1, timeout=None)
+                yield head
+        finally:
+            for actor in pool:
+                try:
+                    kill(actor)
+                except Exception:
+                    pass
+
     def streaming_block_refs(self,
                              window: int = _DEFAULT_WINDOW
                              ) -> Iterator[Any]:
-        """The streaming executor: bounded in-flight block tasks,
-        blocks yielded in input order (backpressure = stop submitting
-        when `window` results are unconsumed)."""
+        """The streaming executor: chained operators, each with a
+        bounded in-flight window, pulled by the consumer. Total live
+        blocks stay ~sum of operator windows no matter how large the
+        dataset is; refs the consumer drops are freed by refcounting."""
         inputs: List[Tuple[Optional[Callable], Any]]
         if self._sources is not None:
             inputs = [(fn, None) for fn in self._sources]
         else:
             inputs = [(None, ref) for ref in (self._block_refs or [])]
-        if not self._stages and self._sources is None:
+        segs = self._segments()
+        if not segs and self._sources is None:
             yield from (ref for _, ref in inputs)
             return
-        in_flight: List[Any] = []
-        it = iter(inputs)
-        exhausted = False
-        while in_flight or not exhausted:
-            while not exhausted and len(in_flight) < window:
-                try:
-                    src_fn, src_ref = next(it)
-                except StopIteration:
-                    exhausted = True
-                    break
-                in_flight.append(_run_block_task.remote(
-                    src_fn, src_ref, self._stages))
-            if in_flight:
-                head = in_flight.pop(0)
-                wait([head], num_returns=1, timeout=None)
-                yield head
+        if ((not segs or segs[0][0] != "tasks")
+                and self._sources is not None):
+            # reads executing under an actor-first pipeline still need a
+            # source op; materialized refs feed the actor pool directly
+            segs.insert(0, ("tasks", []))
+        if segs and segs[0][0] == "tasks":
+            stream: Iterator[Any] = self._task_operator(
+                iter(inputs), segs[0][1], window)
+            rest = segs[1:]
+        else:
+            stream = (ref for _, ref in inputs)
+            rest = segs
+        for seg_kind, payload in rest:
+            if seg_kind == "tasks":
+                stream = self._task_operator(
+                    ((None, ref) for ref in stream), payload, window)
+            else:
+                stream = self._actor_operator(stream, payload)
+        yield from stream
 
     def materialize(self) -> "Dataset":
         refs = list(self.streaming_block_refs())
