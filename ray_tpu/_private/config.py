@@ -56,6 +56,18 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                 "park tasks/actors with no feasible node this "
                                 "long (autoscaler scale-up window) instead of "
                                 "failing immediately; 0 = fail fast"),
+    # --- memory monitor / OOM killing ---
+    "memory_monitor_refresh_ms": (int, 1000,
+                                  "system-memory poll period; 0 disables the "
+                                  "monitor (reference: memory_monitor.h:52)"),
+    "memory_usage_threshold": (float, 0.95,
+                               "used-memory fraction above which a worker is "
+                               "killed (reference: "
+                               "RAY_memory_usage_threshold)"),
+    "task_oom_retries_default": (int, 3,
+                                 "retries for tasks killed by the memory "
+                                 "monitor, counted separately from "
+                                 "max_retries (reference: task_oom_retries)"),
     # --- health / failure ---
     "health_check_period_ms": (int, 3000,
                                "control-plane liveness ping period "

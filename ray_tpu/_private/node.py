@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import queue
 import random
+import signal
 import socket
 import subprocess
 import sys
@@ -25,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions
+from . import memory_monitor
 from . import protocol as P
 from . import scheduler as sched
 from .config import CONFIG
@@ -53,6 +55,12 @@ class _Worker:
     env_key: str = ""
     idle_since: float = 0.0
     log_path: Optional[str] = None
+    # set just before the memory monitor kills the process, so the
+    # conn-closed path reports OutOfMemoryError rather than a crash
+    oom_victim: bool = False
+    # OS pid from the REGISTER handshake, for workers this node did not
+    # spawn itself (proc is None for those)
+    pid: Optional[int] = None
 
 
 @dataclass
@@ -62,6 +70,10 @@ class _TaskRecord:
     deps: Dict[ObjectID, ObjectMeta] = field(default_factory=dict)
     remaining_deps: Set[ObjectID] = field(default_factory=set)
     retries_left: int = 0
+    # OOM kills are budgeted separately from task failures (reference:
+    # task_oom_retries) — transient memory pressure shouldn't consume
+    # the user's max_retries
+    oom_retries_left: int = 0
     worker_id: Optional[WorkerID] = None
     charge: Optional[Dict[str, float]] = None
     pg_key: Optional[tuple] = None
@@ -289,6 +301,9 @@ class NodeService:
         # ORIGINAL deadline (the grace window must not reset under churn)
         self._repark_deadline: Optional[float] = None
 
+        self._memory_monitor = memory_monitor.MemoryMonitor()
+        self._last_mem_check = 0.0
+
         self._rng = random.Random(self.node_id.binary())
 
     # ----------------------------------------------------------- lifecycle
@@ -493,7 +508,14 @@ class NodeService:
             self._reply(key, P.EVENT, ("LOG", payload))
 
     def _tick_loop(self) -> None:
-        while not self._stopped.wait(1.0):
+        while True:
+            # the memory monitor may need sub-second sampling to catch a
+            # ballooning worker before the kernel OOM-killer does; the
+            # other tick work tolerates running at the same faster cadence
+            mm_period = CONFIG.memory_monitor_refresh_ms
+            interval = min(1.0, mm_period / 1000.0) if mm_period > 0 else 1.0
+            if self._stopped.wait(interval):
+                return
             # Heartbeat from THIS thread, not the dispatcher: a slow peer
             # RPC can block the dispatcher past the GCS death deadline
             # (health period × threshold), and a healthy node must not be
@@ -508,10 +530,53 @@ class NodeService:
     def _on_tick(self) -> None:
         self._reap_startup_failures()
         self._reap_idle_workers()
+        self._check_memory_pressure()
         self._retry_infeasible()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
         self._dispatch()
+
+    def _check_memory_pressure(self) -> None:
+        """Kill one worker per check while above the usage threshold
+        (reference: memory_monitor.h:52 + worker_killing_policy.h:34)."""
+        period = CONFIG.memory_monitor_refresh_ms
+        if period <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_mem_check < period / 1000.0:
+            return
+        self._last_mem_check = now
+        frac = self._memory_monitor.usage_fraction()
+        if frac < CONFIG.memory_usage_threshold:
+            return
+        victim = memory_monitor.pick_oom_victim(
+            self._workers.values(),
+            # restarts_left == -1 means restart forever (same contract as
+            # the restart path below): that actor is maximally retriable
+            actor_restartable=lambda aid: (
+                (self._actors.get(aid) or {}).get("restarts_left", 0) != 0))
+        if victim is None:
+            return
+        pid = victim.proc.pid if victim.proc is not None else victim.pid
+        if pid is None:
+            # externally-registered worker we cannot signal: killing only
+            # its connection would leave the process running (no memory
+            # freed, task double-executes on retry)
+            return
+        victim.oom_victim = True
+        snap = self._memory_monitor.snapshot()
+        print(f"[rtpu] node {self.node_id.hex()[:8]}: memory usage "
+              f"{frac:.0%} >= threshold "
+              f"{CONFIG.memory_usage_threshold:.0%}; killing worker "
+              f"pid={pid} ({snap['available_bytes']>>20} MiB avail)",
+              file=sys.stderr)
+        try:
+            if victim.proc is not None:
+                victim.proc.kill()
+            else:
+                os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
 
     def _park_infeasible(self, kind: str, spec) -> bool:
         """Queue work with no feasible node while the autoscaler adds
@@ -675,6 +740,8 @@ class NodeService:
                 except RuntimeError:
                     continue
             return []
+        if what == "memory":
+            return self._memory_monitor.snapshot()
         return None
 
     def _dispatch_loop(self) -> None:
@@ -738,6 +805,7 @@ class NodeService:
                     self._workers[wid] = w
                 w.conn = self._conns[key]
                 w.conn_key = key
+                w.pid = pid
                 self._num_starting = max(0, self._num_starting - 1)
                 self._env_spawn_failures.pop(w.env_key, None)
                 if w.state == "STARTING":
@@ -933,7 +1001,8 @@ class NodeService:
     def _queue_local(self, spec: P.TaskSpec, kind: str,
                      actor_spec: Optional[P.ActorSpec] = None) -> None:
         rec = _TaskRecord(spec=spec, kind=kind, actor_spec=actor_spec,
-                          retries_left=spec.max_retries)
+                          retries_left=spec.max_retries,
+                          oom_retries_left=CONFIG.task_oom_retries_default)
         strategy = spec.scheduling_strategy
         if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
             rec.pg_key = (strategy.pg_id(),
@@ -1944,18 +2013,34 @@ class NodeService:
                 self._running.pop(rec.spec.task_id, None)
                 self._unpin_deps(rec)
                 self._release_charge(rec)
-            self._handle_actor_death(w.actor_id, "actor worker process died")
+            self._handle_actor_death(
+                w.actor_id,
+                "actor worker killed by the memory monitor (node out of "
+                "memory)" if w.oom_victim else "actor worker process died")
             return
         rec = w.task
         if rec is not None:
             self._running.pop(rec.spec.task_id, None)
             self._unpin_deps(rec)
             self._release_charge(rec)
-            if rec.retries_left > 0:
+            if w.oom_victim and rec.oom_retries_left > 0:
+                # OOM retries are a separate budget: the task did nothing
+                # wrong, the node ran out of memory under it
+                rec.oom_retries_left -= 1
+                rec.worker_id = None
+                rec.charge = None
+                self._pending.append(rec)
+            elif not w.oom_victim and rec.retries_left > 0:
                 rec.retries_left -= 1
                 rec.worker_id = None
                 rec.charge = None
                 self._pending.append(rec)
+            elif w.oom_victim:
+                self._fail_returns(rec.spec, exceptions.OutOfMemoryError(
+                    f"task {rec.spec.name} was killed by the memory "
+                    f"monitor to relieve node memory pressure "
+                    f"(usage >= {CONFIG.memory_usage_threshold:.0%}); "
+                    f"oom retries exhausted"))
             else:
                 self._fail_returns(rec.spec, exceptions.WorkerCrashedError(
                     f"worker died while running {rec.spec.name}"))

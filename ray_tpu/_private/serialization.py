@@ -35,11 +35,11 @@ class _MainDetectingPickler(pickle.Pickler):
     (classes/functions pickled by reference that a worker process could
     never import)."""
 
-    main_ref = False
-
     def reducer_override(self, obj):
         if getattr(obj, "__module__", None) == "__main__":
-            self.main_ref = True
+            # abort THIS dump immediately — finishing it just to throw
+            # the result away would pay the full pickle twice
+            raise pickle.PicklingError("__main__ reference")
         return NotImplemented        # standard reduction continues
 
 
@@ -72,8 +72,6 @@ def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
         pickler = _MainDetectingPickler(f, protocol=5,
                                         buffer_callback=buffers.append)
         pickler.dump(obj)
-        if pickler.main_ref:
-            raise pickle.PicklingError("__main__ reference")
         payload = f.getvalue()
     except (pickle.PicklingError, AttributeError, TypeError):
         buffers = []

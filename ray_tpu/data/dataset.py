@@ -51,6 +51,10 @@ class ActorPoolStrategy:
     def __init__(self, size: int = 2, max_in_flight: int = 2,
                  num_cpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None):
+        if size < 1 or max_in_flight < 1:
+            raise ValueError(
+                f"ActorPoolStrategy needs size >= 1 and max_in_flight >= 1 "
+                f"(got size={size}, max_in_flight={max_in_flight})")
         self.size = size
         self.max_in_flight = max_in_flight
         self.num_cpus = num_cpus

@@ -82,6 +82,11 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing a task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The memory monitor killed the worker to relieve node memory
+    pressure (reference analogue: ``ray.exceptions.OutOfMemoryError``)."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Setting up the runtime environment for a task/actor failed."""
 

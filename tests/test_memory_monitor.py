@@ -1,0 +1,132 @@
+"""Memory monitor / OOM worker-killing tests (reference analogues:
+``python/ray/tests/test_memory_pressure.py`` and the policy unit tests in
+``src/ray/raylet/worker_killing_policy_test.cc``).
+
+Pressure is injected via ``RTPU_TEST_MEMORY_USAGE_FRACTION``, which the
+monitor re-reads on every probe — the node service runs in this process,
+so flipping the env var here raises and drops "system" memory pressure.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import (MemoryMonitor, pick_oom_victim)
+from ray_tpu.exceptions import OutOfMemoryError
+
+
+@pytest.fixture
+def pressure_env():
+    yield
+    os.environ.pop("RTPU_TEST_MEMORY_USAGE_FRACTION", None)
+
+
+@ray_tpu.remote
+def _attempt_then_sleep(path, sleep_first_s):
+    with open(path, "a") as f:
+        f.write(f"{os.getpid()}\n")
+        f.flush()
+    with open(path) as f:
+        attempt = len(f.read().splitlines())
+    if attempt == 1:
+        time.sleep(sleep_first_s)
+    return attempt
+
+
+def _wait_for_attempts(path, n, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                if len(f.read().splitlines()) >= n:
+                    return True
+        except OSError:
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def test_monitor_reads_real_memory():
+    frac = MemoryMonitor().usage_fraction()
+    assert 0.0 < frac < 1.0
+    snap = MemoryMonitor().snapshot()
+    assert snap["total_bytes"] > 0
+
+
+def test_oom_kill_retries_and_recovers(tmp_path, pressure_env):
+    ray_tpu.init(num_cpus=4,
+                 _system_config={"memory_monitor_refresh_ms": 200,
+                                 "task_oom_retries_default": 5})
+    try:
+        marker = str(tmp_path / "attempts.txt")
+        ref = _attempt_then_sleep.remote(marker, 60.0)
+        assert _wait_for_attempts(marker, 1)
+        os.environ["RTPU_TEST_MEMORY_USAGE_FRACTION"] = "0.99"
+        # the monitor kills the sleeping worker; the task retries on its
+        # separate OOM budget
+        assert _wait_for_attempts(marker, 2)
+        os.environ.pop("RTPU_TEST_MEMORY_USAGE_FRACTION", None)
+        assert ray_tpu.get(ref, timeout=30) >= 2
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_budget_exhausted_raises(tmp_path, pressure_env):
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"memory_monitor_refresh_ms": 200,
+                                 "task_oom_retries_default": 0})
+    try:
+        marker = str(tmp_path / "attempts.txt")
+        ref = _attempt_then_sleep.options(max_retries=3).remote(marker, 60.0)
+        assert _wait_for_attempts(marker, 1)
+        os.environ["RTPU_TEST_MEMORY_USAGE_FRACTION"] = "0.99"
+        # zero OOM budget: the kill must surface OutOfMemoryError, and the
+        # ordinary max_retries budget must NOT absorb it
+        with pytest.raises(OutOfMemoryError):
+            ray_tpu.get(ref, timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+class _FakeRec:
+    def __init__(self, retries_left=0, oom_retries_left=0):
+        self.retries_left = retries_left
+        self.oom_retries_left = oom_retries_left
+
+
+class _FakeWorker:
+    def __init__(self, state="BUSY", task=None, actor_id=None, started_at=0.0):
+        self.state = state
+        self.task = task
+        self.actor_id = actor_id
+        self.started_at = started_at
+
+
+def test_victim_policy_retriable_lifo():
+    old_retriable = _FakeWorker(task=_FakeRec(retries_left=2), started_at=1.0)
+    new_retriable = _FakeWorker(task=_FakeRec(oom_retries_left=1),
+                                started_at=5.0)
+    non_retriable = _FakeWorker(task=_FakeRec(), started_at=9.0)
+    idle = _FakeWorker(state="IDLE")
+    victim = pick_oom_victim(
+        [idle, non_retriable, old_retriable, new_retriable])
+    assert victim is new_retriable
+    # without any retriable task, the newest non-retriable goes
+    assert pick_oom_victim([non_retriable, idle]) is non_retriable
+    # idle workers are never OOM victims
+    assert pick_oom_victim([idle]) is None
+
+
+def test_victim_policy_prefers_tasks_over_actors():
+    actor = _FakeWorker(state="ACTOR", actor_id="a1", started_at=9.0)
+    task = _FakeWorker(task=_FakeRec(retries_left=1), started_at=1.0)
+    victim = pick_oom_victim([actor, task],
+                             actor_restartable=lambda aid: True)
+    assert victim is task
+    # a restartable actor outranks a non-retriable task
+    dead_end = _FakeWorker(task=_FakeRec(), started_at=1.0)
+    victim = pick_oom_victim([actor, dead_end],
+                             actor_restartable=lambda aid: True)
+    assert victim is actor
