@@ -42,6 +42,11 @@ _CONFIG_DEFS: Dict[str, tuple] = {
     "idle_worker_killing_time_s": (float, 300.0, "kill idle workers after this long"),
     "worker_register_timeout_s": (float, 30.0, "worker registration handshake timeout"),
     "maximum_startup_concurrency": (int, 16, "max concurrent worker process launches"),
+    "runtime_env_setup_timeout_s": (float, 600.0,
+                                    "extra registration budget for workers "
+                                    "building a pip env before first start "
+                                    "(reference: "
+                                    "runtime_env_setup_timeout_seconds)"),
     "worker_startup_max_failures": (int, 3,
                                     "consecutive startup failures per runtime env "
                                     "before pending tasks fail with "

@@ -12,6 +12,7 @@ socket; object payloads ride shared memory (``object_store.py``).
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import random
@@ -61,6 +62,13 @@ class _Worker:
     # OS pid from the REGISTER handshake, for workers this node did not
     # spawn itself (proc is None for those)
     pid: Optional[int] = None
+    # registration deadline override (pip-env workers build a venv before
+    # they can register; 0 = plain CONFIG.worker_register_timeout_s)
+    register_timeout_s: float = 0.0
+    # True while the spawn includes a runtime-env build: a
+    # killed-at-deadline then counts as an ENV failure (the build hung),
+    # not as load
+    env_setup: bool = False
 
 
 @dataclass
@@ -1398,8 +1406,10 @@ class NodeService:
         for wid, w in list(self._workers.items()):
             if w.state != "STARTING" or w.proc is None:
                 continue
+            timeout = (w.register_timeout_s
+                       or CONFIG.worker_register_timeout_s)
             if (w.proc.poll() is not None
-                    or now - w.started_at > CONFIG.worker_register_timeout_s):
+                    or now - w.started_at > timeout):
                 died = w.proc.poll() is not None
                 if not died:
                     try:
@@ -1408,14 +1418,20 @@ class NodeService:
                         pass
                 del self._workers[wid]
                 self._num_starting = max(0, self._num_starting - 1)
-                if died:
-                    # only processes that exited on their own count toward
-                    # the env failure budget — a slow registration (killed
-                    # at the timeout) is load, not a broken env, and must
-                    # not blacklist the default pool
+                if died or w.env_setup:
+                    # Processes that exited on their own count toward the
+                    # env failure budget — a slow registration (killed at
+                    # the timeout) is load, not a broken env, and must
+                    # not blacklist the default pool. EXCEPT during an
+                    # env build: hitting the (much larger) setup deadline
+                    # means the build hung; retrying would wipe and
+                    # rebuild the venv from zero forever.
                     self._env_spawn_failures[w.env_key] = (
                         self._env_spawn_failures.get(w.env_key, 0) + 1)
-                    self._env_spawn_error[w.env_key] = self._worker_log_tail(w)
+                    self._env_spawn_error[w.env_key] = (
+                        self._worker_log_tail(w) if died else
+                        f"runtime env setup did not finish within "
+                        f"{timeout:.0f}s:\n" + self._worker_log_tail(w))
 
     def _spawn_worker(self, env_key: str = "",
                       worker_runtime_env: Optional[dict] = None
@@ -1451,14 +1467,30 @@ class NodeService:
         pp = env.get("PYTHONPATH", "")
         if fw_root not in pp.split(os.pathsep):
             env["PYTHONPATH"] = (pp + os.pathsep if pp else "") + fw_root
+        # pip envs go through the bootstrap, which builds/reuses a cached
+        # venv in the worker process (never blocking this dispatcher) and
+        # execs the real worker under the venv interpreter
+        worker_mod = "ray_tpu._private.worker"
+        pip = renv.pip_spec(worker_runtime_env)
+        if pip is not None:
+            worker_mod = "ray_tpu._private.worker_bootstrap"
+            env["RTPU_PIP_SPEC"] = json.dumps(pip)
+            env["RTPU_ENV_CACHE_DIR"] = os.path.join(
+                self.session_dir, "runtime_envs")
+            register_timeout = (CONFIG.worker_register_timeout_s
+                                + CONFIG.runtime_env_setup_timeout_s)
+        else:
+            register_timeout = 0.0
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker",
+            [sys.executable, "-m", worker_mod,
              self.socket_path, self.node_id.hex(), wid.hex()],
             stdout=out, stderr=subprocess.STDOUT, env=env,
             cwd=cwd)
         out.close()
         self._workers[wid] = _Worker(worker_id=wid, proc=proc,
-                                     env_key=env_key, log_path=log_path)
+                                     env_key=env_key, log_path=log_path,
+                                     register_timeout_s=register_timeout,
+                                     env_setup=pip is not None)
         self._num_starting += 1
         return wid
 

@@ -5,9 +5,10 @@ per-node agent, URI-cached, and the raylet's WorkerPool keys workers by
 (language, runtime env) so tasks only run on workers built for their
 env (``worker_pool.h:152``). Same design here, minus the network-bound
 builders: ``env_vars``, ``working_dir`` and ``py_modules`` are staged
-locally and baked into the worker at spawn; ``pip``/``conda`` are
-rejected up-front (this runtime assumes hermetic images — building
-environments over the network is an explicit non-goal for now).
+locally and baked into the worker at spawn; ``pip`` builds a cached
+virtualenv the worker is exec'd into (``worker_bootstrap.py``);
+``conda``/``container`` are rejected up-front (building those needs
+infrastructure a hermetic image doesn't carry).
 """
 
 from __future__ import annotations
@@ -18,8 +19,8 @@ import os
 import shutil
 from typing import Any, Dict, Optional, Tuple
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
-_REJECTED = {"pip", "conda", "container", "uv"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
+_REJECTED = {"conda", "container", "uv"}
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[dict]:
@@ -33,8 +34,10 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[dict]:
         raise ValueError(
             f"runtime_env keys {sorted(bad)} need network-built "
             "environments, which this runtime does not support; ship a "
-            "hermetic image and use env_vars/working_dir/py_modules")
+            "hermetic image and use env_vars/working_dir/py_modules/pip")
     env = dict(runtime_env)
+    if "pip" in env:
+        env["pip"] = _normalize_pip(env["pip"])
     if "env_vars" in env:
         env["env_vars"] = {str(k): str(v)
                            for k, v in env["env_vars"].items()}
@@ -47,6 +50,59 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[dict]:
         env["py_modules"] = [os.path.abspath(p)
                              for p in env["py_modules"]]
     return env
+
+
+def _normalize_pip(pip: Any) -> dict:
+    """Canonical form: {"packages": [...], "options": [...]}.
+
+    Accepts the reference's shapes — a plain list of requirement
+    specifiers, or a dict with ``packages`` (+ optional
+    ``pip_install_options``, e.g. ``["--no-index"]`` for offline
+    wheel-path installs).
+    """
+    if isinstance(pip, (list, tuple)):
+        pip = {"packages": list(pip)}
+    elif isinstance(pip, dict):
+        unknown = set(pip) - {"packages", "pip_install_options", "options"}
+        if unknown:
+            raise ValueError(
+                f"unknown pip keys: {sorted(unknown)} (supported: "
+                f"packages, pip_install_options)")
+        pkgs = pip.get("packages", [])
+        opts = pip.get("pip_install_options", ()) or pip.get("options", ())
+        if isinstance(pkgs, str) or isinstance(opts, str):
+            raise ValueError(
+                "pip packages/options must be lists of strings, not a "
+                "bare string (a string would be split per character)")
+        pip = {"packages": list(pkgs), "options": list(opts)}
+    else:
+        raise ValueError(f"pip must be a list or dict, got {type(pip)}")
+    pip.setdefault("options", [])
+    for item in pip["packages"] + pip["options"]:
+        if not isinstance(item, str):
+            raise ValueError(f"pip entries must be strings, got {item!r}")
+    return pip
+
+
+def pip_spec(runtime_env: Optional[dict]) -> Optional[dict]:
+    """The bootstrap payload for a pip env: packages, options, and the
+    cache key the venv directory is named by.
+
+    Packages that are local paths (wheels/sdists) contribute their
+    mtime+size to the key, so rebuilding a wheel at the same path gets a
+    fresh venv — the same reason working_dir staging keys on tree mtime.
+    """
+    if not runtime_env or "pip" not in runtime_env:
+        return None
+    pip = runtime_env["pip"]
+    local_state = []
+    for pkg in pip["packages"]:
+        path = pkg.split("#", 1)[0].removeprefix("file://")
+        if os.path.exists(path):
+            st = os.stat(path)
+            local_state.append((pkg, st.st_mtime, st.st_size))
+    return {"key": env_key({"pip": pip, "local": local_state}),
+            "packages": pip["packages"], "options": pip["options"]}
 
 
 def env_key(runtime_env: Optional[dict]) -> str:

@@ -65,16 +65,11 @@ def test_job_level_runtime_env(tmp_path):
 
 
 def test_rejected_keys(rtpu_init):
-    with pytest.raises(Exception):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
-        def f():
-            pass
-
-        f.remote()
-
     from ray_tpu._private.runtime_env import validate
     with pytest.raises(ValueError):
         validate({"conda": "env.yml"})
+    with pytest.raises(ValueError):
+        validate({"container": {"image": "x"}})
     with pytest.raises(ValueError):
         validate({"bogus_key": 1})
 
@@ -145,3 +140,131 @@ def test_broken_env_actor_fails_queued_calls(rtpu_init, tmp_path):
     ref = a.ping.remote()          # queued while the actor is pending
     with pytest.raises(Exception):
         ray_tpu.get(ref, timeout=60)
+
+
+# ---------------------------------------------------------------- pip envs
+
+def _make_wheel(tmp_path, name="rtpu_test_pkg", version="0.1.0",
+                body="VALUE = 42\n"):
+    """Hand-craft a minimal py3-none-any wheel (no network, no build
+    backend) that pip can install from a path with --no-index."""
+    import zipfile
+
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": body,
+        f"{dist}/METADATA": (f"Metadata-Version: 2.1\nName: {name}\n"
+                             f"Version: {version}\n"),
+        f"{dist}/WHEEL": ("Wheel-Version: 1.0\nGenerator: test\n"
+                          "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record = "".join(f"{p},,\n" for p in files) + f"{dist}/RECORD,,\n"
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    with zipfile.ZipFile(whl, "w") as z:
+        for path, content in files.items():
+            z.writestr(path, content)
+        z.writestr(f"{dist}/RECORD", record)
+    return str(whl)
+
+
+def test_pip_env_installs_wheel(rtpu_init, tmp_path):
+    """A task with a pip runtime_env runs inside a venv where the
+    requested package is importable; the default pool is unaffected."""
+    whl = _make_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"pip": {
+        "packages": [whl], "pip_install_options": ["--no-index"]}})
+    def use_pkg():
+        import rtpu_test_pkg
+        import sys
+        return rtpu_test_pkg.VALUE, sys.prefix
+
+    @ray_tpu.remote
+    def no_pkg():
+        try:
+            import rtpu_test_pkg  # noqa: F401
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    value, prefix = ray_tpu.get(use_pkg.remote(), timeout=120)
+    assert value == 42
+    assert "venv-" in prefix          # ran under the built venv
+    assert ray_tpu.get(no_pkg.remote(), timeout=60) == "isolated"
+
+
+def test_pip_env_cached_across_tasks(rtpu_init, tmp_path):
+    """Two tasks sharing one pip env reuse one venv (same sys.prefix)."""
+    whl = _make_wheel(tmp_path)
+    env = {"pip": {"packages": [whl],
+                   "pip_install_options": ["--no-index"]}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def prefix():
+        import sys
+        return sys.prefix
+
+    p1, p2 = ray_tpu.get([prefix.remote(), prefix.remote()], timeout=120)
+    assert p1 == p2
+
+
+def test_pip_env_build_failure_raises(tmp_path):
+    """An uninstallable pip spec surfaces RuntimeEnvSetupError instead of
+    hanging the task."""
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"worker_startup_max_failures": 1})
+    try:
+        @ray_tpu.remote(runtime_env={"pip": {
+            "packages": ["definitely-not-a-real-package-xyz"],
+            "pip_install_options": ["--no-index"]}})
+        def f():
+            return 1
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(f.remote(), timeout=120)
+        assert "RuntimeEnv" in type(ei.value).__name__ or \
+            "runtime" in str(ei.value).lower()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_pip_env_rejects_bad_shapes(rtpu_init):
+    def one():
+        return 1
+
+    # validation fires at submission, matching where the reference's
+    # runtime-env parsing raises
+    with pytest.raises(ValueError):
+        ray_tpu.remote(runtime_env={"pip": 42})(one).remote()
+    with pytest.raises(ValueError):
+        ray_tpu.remote(runtime_env={"conda": ["x"]})(one).remote()
+
+
+def test_pip_env_strict_validation(rtpu_init):
+    from ray_tpu._private import runtime_env as renv
+
+    # a bare string would be char-split into bogus package names
+    with pytest.raises(ValueError):
+        renv.validate({"pip": {"packages": "numpy"}})
+    # unknown dict keys (typos) must not silently produce an empty env
+    with pytest.raises(ValueError):
+        renv.validate({"pip": {"packges": ["numpy"]}})
+    # canonical shapes pass
+    assert renv.validate({"pip": ["numpy"]})["pip"]["packages"] == ["numpy"]
+
+
+def test_pip_env_key_tracks_local_wheel(tmp_path):
+    """Rebuilding a wheel at the same path must produce a different venv
+    cache key (stale-venv guard)."""
+    import time as _time
+
+    from ray_tpu._private import runtime_env as renv
+
+    whl = _make_wheel(tmp_path)
+    env = renv.validate({"pip": [whl]})
+    k1 = renv.pip_spec(env)["key"]
+    _time.sleep(0.01)
+    import os as _os
+    _os.utime(whl)                      # simulate a rebuild
+    k2 = renv.pip_spec(env)["key"]
+    assert k1 != k2
