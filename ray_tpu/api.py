@@ -104,6 +104,12 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node instead of immediate submission (reference:
+        ``dag/function_node.py``)."""
+        from .dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._name} cannot be called directly; "
@@ -135,6 +141,16 @@ class ActorMethod:
         m = ActorMethod(self._handle, self._method_name)
         m._opts = opts
         return m
+
+    def bind(self, *args, **kwargs):
+        """DAG node calling this method on the LIVE handle (reference:
+        binding methods of an existing actor into a DAG)."""
+        from .dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
+    @property
+    def _name(self):
+        return f"{self._handle._class_name}.{self._method_name}"
 
     def remote(self, *args, **kwargs):
         client = context.require_client()
@@ -259,6 +275,11 @@ class ActorClass:
             if not name.startswith("__") and inspect.iscoroutinefunction(member):
                 return True
         return False
+
+    def bind(self, *args, **kwargs):
+        """Lazy actor-creation DAG node (reference: ``dag/class_node.py``)."""
+        from .dag import ClassNode
+        return ClassNode(self, args, kwargs)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
