@@ -131,6 +131,32 @@ def init(address: Optional[Any] = None,
         conn = _P.connect_address(head_tcp_address)
         node_id = _NodeID.from_hex(head["node_id"])
     client = CoreClient(conn, job_id, WorkerID.from_random(), _P.KIND_DRIVER)
+    if _global_node is None:
+        # Ray-Client-equivalent attach: when this driver does not share
+        # /dev/shm with the head node, object payloads must ride the
+        # socket instead of shared memory. Primary signal: read the
+        # head's shm probe token back (a direct capability test —
+        # hostname equality lies when containers share names). The
+        # RTPU_NODE_HOST override keeps the test hook for simulating
+        # foreign hosts on one machine.
+        my_host = os.environ.get("RTPU_NODE_HOST")
+        head_host = head.get("host")
+        if my_host:
+            client.wire_data_plane = bool(head_host) and head_host != my_host
+        else:
+            probe = head.get("shm_probe") or (None, None)
+            same_shm = False
+            if probe[0]:
+                try:
+                    with open(probe[0]) as _f:
+                        same_shm = _f.read().strip() == probe[1]
+                except OSError:
+                    same_shm = False
+            else:
+                import socket as _socket
+                same_shm = (not head_host
+                            or head_host == _socket.gethostname())
+            client.wire_data_plane = not same_shm
     conn.send((_P.REGISTER, (_P.KIND_DRIVER, client.worker_id.binary(),
                              os.getpid())))
     client.start_reader()

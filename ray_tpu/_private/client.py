@@ -25,6 +25,13 @@ from .object_store import ObjectMeta, ObjectReader, create_segment
 from . import serialization as ser
 
 
+def _flat_bytes(smeta, views, total: int) -> bytes:
+    """Write the (meta, buffers) wire format into one contiguous blob."""
+    out = bytearray(total)
+    ser.write_to(memoryview(out), smeta, views)
+    return bytes(out)
+
+
 class CoreClient:
     def __init__(self, conn: P.Connection, job_id: JobID,
                  worker_id: WorkerID, kind: int):
@@ -34,6 +41,10 @@ class CoreClient:
         self.kind = kind
         self.node_id = None         # set by driver init / worker runtime
         self.namespace = "default"  # set by init(namespace=...)
+        # Ray-Client-equivalent mode: this process shares no /dev/shm
+        # with the node it is connected to, so object payloads must ride
+        # the socket (set by init() when the head's host differs)
+        self.wire_data_plane = False
         self.reader = ObjectReader()
         self._futures: Dict[int, Future] = {}
         self._req_lock = threading.Lock()
@@ -226,6 +237,9 @@ class CoreClient:
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
+        if self.wire_data_plane:
+            self._wire_put(oid, *self._serialize_flat(value))
+            return ObjectRef(oid)
         meta = self._store_value(oid, value)
         if meta.shm_name is not None or meta.arena_ref is not None:
             # Large object: block until the node store adopts it, so the
@@ -261,10 +275,25 @@ class CoreClient:
         smeta, views = ser.serialize(value)
         total = ser.serialized_size(smeta, views)
         if total <= CONFIG.max_inline_object_bytes:
-            out = bytearray(total)
-            ser.write_to(memoryview(out), smeta, views)
-            return ObjectMeta(object_id=oid, size=total, inline=bytes(out))
+            return ObjectMeta(object_id=oid, size=total,
+                              inline=_flat_bytes(smeta, views, total))
         return self.store_large(oid, smeta, views, total)
+
+    @staticmethod
+    def _serialize_flat(value: Any) -> Tuple[bytes, int]:
+        smeta, views = ser.serialize(value)
+        total = ser.serialized_size(smeta, views)
+        return _flat_bytes(smeta, views, total), total
+
+    def _wire_put(self, oid: ObjectID, data: bytes, total: int) -> None:
+        """Cross-host put: the payload rides the socket and the NODE
+        materializes it as the primary copy (we have no shared shm)."""
+        if total <= CONFIG.max_inline_object_bytes:
+            self._send(P.PUT_OBJECT,
+                       ObjectMeta(object_id=oid, size=total, inline=data))
+        else:
+            self._request(P.PUT_OBJECT_WIRE,
+                          lambda rid: (rid, oid, data)).result()
 
     def store_large(self, oid: ObjectID, smeta, views,
                     total: int) -> ObjectMeta:
@@ -290,10 +319,15 @@ class CoreClient:
         seg.close()
         return ObjectMeta(object_id=oid, size=total, shm_name=name)
 
+    @property
+    def _get_op(self) -> int:
+        return (P.GET_OBJECTS_FETCH if self.wire_data_plane
+                else P.GET_OBJECTS)
+
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         ids = [r.id for r in refs]
-        fut = self._request(P.GET_OBJECTS,
+        fut = self._request(self._get_op,
                             lambda rid: (rid, ids, timeout))
         metas = fut.result()
         out = []
@@ -309,15 +343,23 @@ class CoreClient:
         # store, so retry a couple of times before giving up. The retry
         # keeps the caller's timeout so get(timeout=...) stays bounded.
         for attempt in range(3):
-            try:
-                return self.reader.load(meta)
-            except FileNotFoundError:
+            if meta is None:
+                # lost between readiness and lookup (or the wire-fetch
+                # payload vanished mid-copy); retry once, then surface
                 if attempt == 2:
-                    raise
-                self.reader.release(meta.shm_name)
-                meta = self._request(
-                    P.GET_OBJECTS,
-                    lambda rid: (rid, [ref.id], timeout)).result()[0]
+                    break
+            else:
+                try:
+                    return self.reader.load(meta)
+                except FileNotFoundError:
+                    if attempt == 2:
+                        raise
+                    self.reader.release(meta.shm_name)
+            meta = self._request(
+                self._get_op,
+                lambda rid: (rid, [ref.id], timeout)).result()[0]
+        from ..exceptions import ObjectLostError
+        raise ObjectLostError(ref.id, "object vanished during get()")
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
@@ -340,6 +382,14 @@ class CoreClient:
             def _resolve(fut: Future):
                 try:
                     meta = fut.result()[0]
+                    if meta is None:
+                        from ..exceptions import ObjectLostError
+                        if attempts_left > 0:
+                            _attempt(attempts_left - 1)
+                        else:
+                            out.set_exception(ObjectLostError(
+                                ref.id, "object vanished during get()"))
+                        return
                     out.set_result(self.reader.load(meta))
                 except FileNotFoundError:
                     # Segment spilled between reply and attach. This
@@ -355,7 +405,7 @@ class CoreClient:
                 except BaseException as e:  # noqa: BLE001
                     out.set_exception(e)
 
-            inner = self._request(P.GET_OBJECTS,
+            inner = self._request(self._get_op,
                                   lambda rid: (rid, [ref.id], None))
             inner.add_done_callback(_resolve)
 
@@ -381,6 +431,9 @@ class CoreClient:
         # the same reason as put(): the store's budget accounting must not
         # lag behind a writer looping over f.remote(big_array).
         oid = ObjectID.for_put(self.worker_id)
+        if self.wire_data_plane:
+            self._wire_put(oid, _flat_bytes(smeta, views, total), total)
+            return ("r", oid)
         meta = self.store_large(oid, smeta, views, total)
         self._sync_put(meta)
         return ("r", oid)

@@ -82,7 +82,10 @@ def main(argv=None) -> int:
         # drivers attaching by GCS address find the head node here
         gcs.kv_put(b"__rtpu_head_node",
                    json.dumps({"node_id": node.node_id.hex(),
-                               "address": node.tcp_address}).encode())
+                               "address": node.tcp_address,
+                               "host": node.host,
+                               "shm_probe": [node.shm_probe_path,
+                                             node.shm_probe_token]}).encode())
         # job submission API (reference: dashboard job head)
         from ..job.http_server import JobRestServer
         from ..job.manager import JobManager

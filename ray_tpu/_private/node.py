@@ -34,6 +34,7 @@ from .config import CONFIG
 from .gcs import (ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING,
                   GlobalControlPlane, NodeInfo, TaskEvent)
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from . import object_store
 from .object_store import ObjectMeta, ObjectStore
 from .rpc import RpcChannel
 from .serialization import to_bytes
@@ -117,6 +118,8 @@ class _Waiter:
     num_returns: int = 0                  # for WAIT; 0 means GET (need all)
     timer: Optional[threading.Timer] = None
     fired: bool = False
+    # cross-host driver GET: inline payload bytes into the reply metas
+    fetch: bool = False
 
 
 class _RemotePeer:
@@ -312,6 +315,10 @@ class NodeService:
         self._memory_monitor = memory_monitor.MemoryMonitor()
         self._last_mem_check = 0.0
 
+        # set in start() when a TCP plane exists (see the probe comment)
+        self.shm_probe_path: Optional[str] = None
+        self.shm_probe_token: Optional[str] = None
+
         self._rng = random.Random(self.node_id.binary())
 
     # ----------------------------------------------------------- lifecycle
@@ -329,6 +336,18 @@ class NodeService:
             self._tcp_listener = P.listen_tcp(port=tcp_port)
             self.tcp_address = (
                 f"{advertise_host}:{self._tcp_listener.getsockname()[1]}")
+            # Shared-memory capability probe: a driver that can read this
+            # token back shares our /dev/shm and may use the shm data
+            # plane; one that can't must ship payloads over the socket.
+            # A direct probe beats hostname comparison (containers often
+            # share names across machines).
+            self.shm_probe_path = f"/dev/shm/rtpu_probe_{self.node_id.hex()[:12]}"
+            self.shm_probe_token = os.urandom(8).hex()
+            try:
+                with open(self.shm_probe_path, "w") as f:
+                    f.write(self.shm_probe_token)
+            except OSError:
+                self.shm_probe_path = None
         self.gcs.register_node(NodeInfo(
             node_id=self.node_id,
             address=self.tcp_address or self.socket_path,
@@ -397,6 +416,11 @@ class NodeService:
                     listener.close()
                 except OSError:
                     pass
+        if self.shm_probe_path:
+            try:
+                os.unlink(self.shm_probe_path)
+            except OSError:
+                pass
         for peer in list(self._peers.values()):
             peer.close()
         self._peers.clear()
@@ -656,7 +680,7 @@ class NodeService:
     _DIRECT_OPS = frozenset({P.NODE_POST, P.OBJ_GET_META, P.OBJ_UNPIN,
                              P.OBJ_PULL, P.PG_RESERVE, P.PG_RELEASE,
                              P.NODE_STATS, P.ALLOC_OBJECT, P.PUT_OBJECT,
-                             P.PUT_OBJECT_SYNC})
+                             P.PUT_OBJECT_SYNC, P.PUT_OBJECT_WIRE})
 
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
@@ -679,7 +703,7 @@ class NodeService:
                         result = False if op == P.PG_RESERVE else None
                         self._reply(key, P.INFO_REPLY,
                                     (payload[0], result))
-                    elif (op == P.PUT_OBJECT_SYNC
+                    elif (op in (P.PUT_OBJECT_SYNC, P.PUT_OBJECT_WIRE)
                           and isinstance(payload, tuple)):
                         err = to_bytes(RuntimeError(
                             "put failed on the node store"))
@@ -724,6 +748,33 @@ class NodeService:
             try:
                 self._seal_object(meta)
             except Exception as e:  # noqa: BLE001 — client put() blocks
+                self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
+            else:
+                self._reply(key, P.PUT_REPLY, (req_id,))
+        elif op == P.PUT_OBJECT_WIRE:
+            # cross-host driver put: payload arrived over the socket;
+            # materialize it in OUR store as the primary copy
+            req_id, oid, data = payload
+            name = None
+            try:
+                seg = object_store.create_segment(oid, len(data))
+                seg.buf[:len(data)] = data
+                name = seg.name
+                seg.close()
+                self._seal_object(ObjectMeta(object_id=oid,
+                                             size=len(data),
+                                             shm_name=name))
+            except Exception as e:  # noqa: BLE001 — client put() blocks
+                if name is not None:
+                    # seal rejected it: no store owns the segment, so it
+                    # would leak /dev/shm forever (and FileExistsError any
+                    # client retry of the same oid)
+                    try:
+                        seg = object_store.attach_segment(name)
+                        seg.close()
+                        seg.unlink()
+                    except Exception:   # noqa: BLE001 — best-effort
+                        pass
                 self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
             else:
                 self._reply(key, P.PUT_REPLY, (req_id,))
@@ -829,6 +880,8 @@ class NodeService:
             self._submit_actor_task(payload)
         elif op == P.GET_OBJECTS:
             self._get_objects(key, *payload)
+        elif op == P.GET_OBJECTS_FETCH:
+            self._get_objects(key, *payload, fetch=True)
         elif op == P.WAIT_OBJECTS:
             self._wait_objects(key, *payload)
         elif op == P.FREE_OBJECTS:
@@ -1913,9 +1966,10 @@ class NodeService:
     # ------------------------------------------------------------- get/wait
     def _get_objects(self, conn_key: int, req_id: int,
                      object_ids: List[ObjectID],
-                     timeout: Optional[float]) -> None:
+                     timeout: Optional[float],
+                     fetch: bool = False) -> None:
         waiter = _Waiter(req_id=req_id, conn_key=conn_key,
-                         object_ids=object_ids)
+                         object_ids=object_ids, fetch=fetch)
         for oid in object_ids:
             if not self._object_exists(oid):
                 waiter.remaining.add(oid)
@@ -1951,8 +2005,48 @@ class NodeService:
                 self._fire_wait(waiter)
 
     def _fire_get(self, waiter: _Waiter) -> None:
+        if waiter.fetch:
+            # Payload copies + frame pickling for a wire driver can be
+            # hundreds of MB; do them off the dispatcher (Connection.send
+            # is thread-safe), mirroring why puts live in _DIRECT_OPS.
+            metas = [self._lookup_object(oid) for oid in waiter.object_ids]
+            threading.Thread(
+                target=self._fire_get_fetch,
+                args=(waiter, metas), daemon=True,
+                name="rtpu-wire-fetch").start()
+            return
         metas = [self._lookup_object(oid) for oid in waiter.object_ids]
         self._reply(waiter.conn_key, P.GET_REPLY, (waiter.req_id, metas))
+
+    def _fire_get_fetch(self, waiter: _Waiter, metas) -> None:
+        wire = [self._wire_meta(oid, meta)
+                for oid, meta in zip(waiter.object_ids, metas)]
+        self._reply(waiter.conn_key, P.GET_REPLY, (waiter.req_id, wire))
+
+    def _wire_meta(self, oid: ObjectID,
+                   meta: Optional[ObjectMeta]) -> Optional[ObjectMeta]:
+        """Meta with the payload inlined, for drivers that share no
+        /dev/shm with this host (Ray-Client-equivalent data plane).
+        ``meta`` comes from ``_lookup_object``, which has already adopted
+        cross-host payloads into our store via the peer pull. Never
+        raises: a None return makes the client surface ObjectLostError."""
+        if meta is None or meta.inline is not None or meta.error is not None:
+            return meta
+        try:
+            res = self.store.read_payload(oid)
+            if res is not None:
+                meta, data = res
+                if data is None:         # store held it inline / as error
+                    return meta
+            else:
+                # same-host sibling store (in-process cluster): attach by
+                # segment name / arena path
+                data = object_store.read_wire_bytes(meta)
+        except Exception:                # noqa: BLE001 — must always reply
+            return None
+        if data is None:
+            return None
+        return ObjectMeta(object_id=oid, size=meta.size, inline=data)
 
     def _drop_waiter_index(self, waiter_id: int, waiter: _Waiter) -> None:
         for oid in waiter.remaining:

@@ -533,6 +533,25 @@ class ObjectStore:
 
 # --------------------------------------------------------------- client side
 
+def read_wire_bytes(meta: ObjectMeta) -> Optional[bytes]:
+    """Copy an object's serialized payload out of its backing storage
+    (any same-host segment/arena, not necessarily this process's store).
+    Used to inline payloads into replies for cross-host drivers."""
+    if meta.inline is not None:
+        return meta.inline
+    if meta.arena_ref is not None:
+        from . import native
+        path, off = meta.arena_ref
+        return bytes(native.ArenaReader.get(path).buffer(off, meta.size))
+    if meta.shm_name is not None:
+        seg = attach_segment(meta.shm_name)
+        try:
+            return bytes(seg.buf[:meta.size])
+        finally:
+            seg.close()
+    return None
+
+
 class ObjectReader:
     """Per-process cache of attached segments for zero-copy reads."""
 

@@ -71,6 +71,14 @@ REF_REGISTER = 37       # ObjectID — this client now holds a reference
 REF_DROP = 38           # ObjectID — this client's last local ref died
 REF_BATCH = 39          # [(op, ObjectID), ...] — coalesced edge stream
 
+# Cross-host driver data plane (Ray-Client-equivalent attach: the driver
+# shares no /dev/shm with the cluster, so payloads ride the socket).
+# Numbered after the reply range — 40-51 are already taken below.
+GET_OBJECTS_FETCH = 52  # (req_id, [ObjectID], timeout) — GET_REPLY metas
+                        # with shm/arena payloads converted to inline
+PUT_OBJECT_WIRE = 53    # (req_id, ObjectID, bytes) — node materializes
+                        # the payload in ITS store and seals
+
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
 GET_REPLY = 41          # (req_id, [ObjectMeta])
