@@ -43,6 +43,9 @@ def main(argv=None) -> int:
     ap.add_argument("--ready-file", default=None)
     ap.add_argument("--job-port", type=int, default=0,
                     help="head only: REST port for job submission (0 = auto)")
+    ap.add_argument("--dashboard-port", type=int, default=0,
+                    help="head only: dashboard HTTP port (0 = auto, "
+                         "-1 = disabled)")
     args = ap.parse_args(argv)
 
     if bool(args.head) == bool(args.address):
@@ -98,9 +101,20 @@ def main(argv=None) -> int:
         gcs.kv_put(b"__rtpu_job_api",
                    f"{args.advertise_host}:{job_port}".encode())
 
+    dashboard = None
+    dashboard_port = None
+    if args.head and args.dashboard_port >= 0:
+        from ..dashboard import DashboardServer
+        dashboard = DashboardServer(node, job_manager=manager,
+                                    port=args.dashboard_port)
+        dashboard.start()
+        dashboard_port = dashboard.port
+        gcs.kv_put(b"__rtpu_dashboard",
+                   f"{args.advertise_host}:{dashboard_port}".encode())
+
     ready = {"node_id": node.node_id.hex(), "gcs_port": gcs_port,
              "node_address": node.tcp_address, "session_dir": session_dir,
-             "job_port": job_port}
+             "job_port": job_port, "dashboard_port": dashboard_port}
     line = json.dumps(ready)
     if args.ready_file:
         tmp = args.ready_file + ".tmp"
@@ -123,6 +137,8 @@ def main(argv=None) -> int:
                 break
     finally:
         node.stop()
+        if dashboard is not None:
+            dashboard.stop()
         if job_rest is not None:
             job_rest.stop()
         if gcs_server is not None:

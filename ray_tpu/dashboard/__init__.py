@@ -1,0 +1,143 @@
+"""Cluster dashboard: JSON API + single-page HTML overview on the head.
+
+Reference analogue: ``dashboard/`` (dashboard head + its api modules —
+nodes, actors, jobs, state). Scope here is the observability core:
+cluster/node/actor/task/object/PG state, task+actor summaries, and a
+Chrome-trace timeline export, served as `/api/*` JSON the same way the
+reference's dashboard API serves its SPA — plus a dependency-free HTML
+page instead of a React bundle.
+
+Runs inside the head node process reading GCS/node state directly (no
+client connection), so it keeps answering while drivers come and go.
+"""
+
+from __future__ import annotations
+
+from .._private.http_util import HttpServerBase, JsonHandler
+from ..state import api as state_api
+
+_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 1.5rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.4rem; }
+ table { border-collapse: collapse; margin-top: .4rem; font-size: .85rem; }
+ th, td { border: 1px solid #ccc; padding: .25rem .6rem; text-align: left; }
+ th { background: #f3f3f3; }
+ .pill { display: inline-block; padding: 0 .5rem; border-radius: 999px;
+         background: #eef; margin-right: .4rem; }
+ #err { color: #a00; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="cluster"></div><div id="err"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Tasks (latest state)</h2><table id="tasks"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<script>
+// all cluster-supplied strings (task/actor names, labels, entrypoints)
+// are attacker-controlled: never reach innerHTML unescaped
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, ch => ({"&": "&amp;", "<": "&lt;",
+    ">": "&gt;", '"': "&quot;", "'": "&#39;"}[ch]));
+}
+function fill(id, rows, cols) {
+  const t = document.getElementById(id);
+  if (!rows || !rows.length) { t.innerHTML = "<tr><td>none</td></tr>"; return; }
+  cols = cols || Object.keys(rows[0]);
+  let h = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  for (const r of rows.slice(-50))
+    h += "<tr>" + cols.map(
+      c => `<td>${esc(JSON.stringify(r[c]) ?? "")}</td>`).join("") + "</tr>";
+  t.innerHTML = h;
+}
+async function refresh() {
+  try {
+    const c = await (await fetch("api/cluster")).json();
+    document.getElementById("cluster").innerHTML =
+      Object.entries(c.resources_total || {}).map(
+        ([k, v]) => `<span class="pill">${esc(k)}: ` +
+          `${esc((c.resources_available||{})[k] ?? "?")} / ` +
+          `${esc(v)}</span>`).join("") +
+      `<span class="pill">nodes: ${esc(c.num_nodes)}</span>` +
+      `<span class="pill">mem used: ` +
+      `${esc(((c.memory||{}).usage_fraction*100).toFixed(0))}%</span>`;
+    fill("nodes", (await (await fetch("api/nodes")).json()).nodes);
+    fill("actors", (await (await fetch("api/actors")).json()).actors);
+    fill("tasks", (await (await fetch("api/tasks")).json()).tasks);
+    fill("jobs", (await (await fetch("api/jobs")).json()).jobs);
+    document.getElementById("err").textContent = "";
+  } catch (e) { document.getElementById("err").textContent = String(e); }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class _Handler(JsonHandler):
+    node = None           # NodeService, set by server factory
+    job_manager = None    # optional JobManager
+
+    def do_GET(self):   # noqa: C901 — flat route table
+        path = self.path.split("?", 1)[0].rstrip("/")
+        node = self.node
+        try:
+            if path in ("", "/", "/index.html"):
+                return self._html(_HTML)
+            if path == "/api/cluster":
+                mem = node.node_stats("memory") or {}
+                nodes = node._cluster_info("nodes") or []
+                return self._json(200, {
+                    "num_nodes": sum(1 for n in nodes if n["alive"]),
+                    "resources_total": node._cluster_info("resources_total"),
+                    "resources_available":
+                        node._cluster_info("resources_available"),
+                    "memory": mem,
+                })
+            if path == "/api/nodes":
+                return self._json(200, {"nodes": state_api.shape_nodes(
+                    node._cluster_info("nodes"))})
+            if path == "/api/workers":
+                return self._json(200,
+                                  {"workers": node._cluster_info("workers")})
+            if path == "/api/actors":
+                return self._json(200, {"actors": state_api.shape_actors(
+                    node._state_query("actors", None))})
+            if path == "/api/tasks":
+                return self._json(200, {"tasks": state_api.shape_tasks(
+                    node._state_query("tasks", None))})
+            if path == "/api/objects":
+                return self._json(200, {"objects": state_api.shape_objects(
+                    node._state_query("objects", None))})
+            if path == "/api/placement_groups":
+                return self._json(200, {
+                    "placement_groups": state_api.shape_placement_groups(
+                        node._state_query("placement_groups", None))})
+            if path == "/api/summary":
+                tasks = state_api.shape_tasks(
+                    node._state_query("tasks", None))
+                actors = state_api.shape_actors(
+                    node._state_query("actors", None))
+                return self._json(200, {
+                    "tasks": state_api.summarize_task_rows(tasks),
+                    "actors": state_api.summarize_actor_rows(actors)})
+            if path == "/api/jobs":
+                if self.job_manager is None:
+                    return self._json(200, {"jobs": []})
+                return self._json(200,
+                                  {"jobs": self.job_manager.list_jobs()})
+            return self._json(404, {"error": f"no route {self.path}"})
+        except Exception as e:   # noqa: BLE001 — API surface
+            return self._json(500, {"error": str(e)})
+
+
+class DashboardServer(HttpServerBase):
+    """HTTP server bound to a NodeService (start on the head)."""
+
+    thread_name = "rtpu-dashboard"
+
+    def __init__(self, node, job_manager=None, host: str = "0.0.0.0",
+                 port: int = 0):
+        super().__init__(_Handler, host=host, port=port,
+                         node=node, job_manager=job_manager)

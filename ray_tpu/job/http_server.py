@@ -12,31 +12,12 @@ shapes on the head node:
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-
+from .._private.http_util import HttpServerBase, JsonHandler
 from .manager import JobManager
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandler):
     manager: JobManager = None   # set by server factory
-
-    def log_message(self, *args):   # quiet
-        pass
-
-    def _json(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _body(self):
-        n = int(self.headers.get("Content-Length") or 0)
-        return json.loads(self.rfile.read(n) or b"{}")
 
     def do_POST(self):
         parts = [p for p in self.path.split("/") if p]
@@ -80,18 +61,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": str(e)})
 
 
-class JobRestServer:
+class JobRestServer(HttpServerBase):
+    thread_name = "rtpu-job-rest"
+
     def __init__(self, manager: JobManager, host: str = "0.0.0.0",
                  port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {"manager": manager})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="rtpu-job-rest", daemon=True)
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
+        super().__init__(_Handler, host=host, port=port, manager=manager)

@@ -28,68 +28,79 @@ def _apply_filters(rows: List[dict], filters: Optional[dict]) -> List[dict]:
     return out
 
 
-def list_tasks(filters: Optional[dict] = None,
-               limit: int = 1000) -> List[dict]:
-    """Task state transitions (latest state per task)."""
-    events = _query("tasks") or []
+def _hex(v) -> str:
+    return v.hex() if hasattr(v, "hex") else str(v)
+
+
+# Row shaping is shared with the dashboard, which reads the same raw
+# records directly from the head's GCS (no client in that process).
+
+def shape_tasks(events: List[dict]) -> List[dict]:
     latest: Dict[Any, dict] = {}
-    for ev in events:
+    for ev in events or []:
         latest[ev["task_id"]] = {
-            "task_id": ev["task_id"].hex() if hasattr(ev["task_id"], "hex")
-            else str(ev["task_id"]),
+            "task_id": _hex(ev["task_id"]),
             "name": ev["name"],
             "state": ev["state"],
-            "node_id": (ev["node_id"].hex()
-                        if hasattr(ev["node_id"], "hex")
-                        else str(ev["node_id"])),
+            "node_id": _hex(ev["node_id"]),
             "is_actor_task": ev.get("is_actor_task", False),
             "timestamp": ev["timestamp"],
         }
-    rows = sorted(latest.values(), key=lambda r: r["timestamp"])
+    return sorted(latest.values(), key=lambda r: r["timestamp"])
+
+
+def shape_actors(recs: List[dict]) -> List[dict]:
+    return [{
+        "actor_id": _hex(rec["actor_id"]),
+        "class_name": rec["class_name"],
+        "name": rec.get("name"),
+        "state": rec["state"],
+        "num_restarts": rec.get("num_restarts", 0),
+    } for rec in recs or []]
+
+
+def shape_objects(recs: List[dict]) -> List[dict]:
+    return [{
+        "object_id": _hex(rec["object_id"]),
+        "node_id": _hex(rec["node_id"]),
+        "size": rec["size"],
+    } for rec in recs or []]
+
+
+def shape_placement_groups(recs: List[dict]) -> List[dict]:
+    return [{
+        "pg_id": _hex(rec["pg_id"]),
+        "state": rec.get("state"),
+        "bundles": rec["bundles"],
+        "strategy": rec["strategy"],
+    } for rec in recs or []]
+
+
+def shape_nodes(recs: List[dict]) -> List[dict]:
+    return [{**rec, "node_id": _hex(rec["node_id"])} for rec in recs or []]
+
+
+def list_tasks(filters: Optional[dict] = None,
+               limit: int = 1000) -> List[dict]:
+    """Task state transitions (latest state per task)."""
+    rows = shape_tasks(_query("tasks"))
     return _apply_filters(rows, filters)[:limit]
 
 
 def list_actors(filters: Optional[dict] = None,
                 limit: int = 1000) -> List[dict]:
-    rows = []
-    for rec in _query("actors") or []:
-        rows.append({
-            "actor_id": rec["actor_id"].hex()
-            if hasattr(rec["actor_id"], "hex") else str(rec["actor_id"]),
-            "class_name": rec["class_name"],
-            "name": rec.get("name"),
-            "state": rec["state"],
-            "num_restarts": rec.get("num_restarts", 0),
-        })
-    return _apply_filters(rows, filters)[:limit]
+    return _apply_filters(shape_actors(_query("actors")), filters)[:limit]
 
 
 def list_objects(filters: Optional[dict] = None,
                  limit: int = 1000) -> List[dict]:
-    rows = []
-    for rec in _query("objects") or []:
-        rows.append({
-            "object_id": rec["object_id"].hex()
-            if hasattr(rec["object_id"], "hex") else str(rec["object_id"]),
-            "node_id": rec["node_id"].hex()
-            if hasattr(rec["node_id"], "hex") else str(rec["node_id"]),
-            "size": rec["size"],
-        })
-    return _apply_filters(rows, filters)[:limit]
+    return _apply_filters(shape_objects(_query("objects")), filters)[:limit]
 
 
 def list_placement_groups(filters: Optional[dict] = None,
                           limit: int = 1000) -> List[dict]:
-    rows = []
-    for rec in _query("placement_groups") or []:
-        rows.append({
-            "pg_id": rec["pg_id"].hex()
-            if hasattr(rec["pg_id"], "hex") else str(rec["pg_id"]),
-            "state": rec.get("state"),
-            "bundles": rec["bundles"],
-            "strategy": rec["strategy"],
-        })
-    return _apply_filters(rows, filters)[:limit]
+    return _apply_filters(
+        shape_placement_groups(_query("placement_groups")), filters)[:limit]
 
 
 def list_nodes(filters: Optional[dict] = None) -> List[dict]:
@@ -102,9 +113,7 @@ def list_workers(filters: Optional[dict] = None) -> List[dict]:
         _ctx.require_client().cluster_info("workers") or [], filters)
 
 
-def summarize_tasks() -> Dict[str, Any]:
-    """Count by (name, state) — reference: ``ray summary tasks``."""
-    rows = list_tasks(limit=10**9)
+def summarize_task_rows(rows: List[dict]) -> Dict[str, Any]:
     by_state = Counter(r["state"] for r in rows)
     by_func: Dict[str, Counter] = defaultdict(Counter)
     for r in rows:
@@ -113,14 +122,22 @@ def summarize_tasks() -> Dict[str, Any]:
             "by_func": {k: dict(v) for k, v in by_func.items()}}
 
 
-def summarize_actors() -> Dict[str, Any]:
-    rows = list_actors(limit=10**9)
+def summarize_actor_rows(rows: List[dict]) -> Dict[str, Any]:
     by_state = Counter(r["state"] for r in rows)
     by_class: Dict[str, Counter] = defaultdict(Counter)
     for r in rows:
         by_class[r["class_name"]][r["state"]] += 1
     return {"total": len(rows), "by_state": dict(by_state),
             "by_class": {k: dict(v) for k, v in by_class.items()}}
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Count by (name, state) — reference: ``ray summary tasks``."""
+    return summarize_task_rows(list_tasks(limit=10**9))
+
+
+def summarize_actors() -> Dict[str, Any]:
+    return summarize_actor_rows(list_actors(limit=10**9))
 
 
 def timeline(filename: Optional[str] = None) -> Any:

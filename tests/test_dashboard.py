@@ -1,0 +1,103 @@
+"""Dashboard tests (reference analogue: ``dashboard/tests`` — the API
+modules serving cluster state over HTTP)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import DashboardServer
+
+
+def _fetch(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def _fetch_json(port, path):
+    status, body = _fetch(port, path)
+    assert status == 200, (path, body)
+    return json.loads(body)
+
+
+@pytest.fixture
+def dashboard(rtpu_init):
+    server = DashboardServer(ray_tpu._global_node, host="127.0.0.1")
+    server.start()
+    yield server
+    server.stop()
+
+
+@ray_tpu.remote
+def _work(x):
+    return x + 1
+
+
+@ray_tpu.remote
+class _Stateful:
+    def ping(self):
+        return "pong"
+
+
+def test_cluster_endpoint(dashboard):
+    data = _fetch_json(dashboard.port, "/api/cluster")
+    assert data["num_nodes"] == 1
+    assert data["resources_total"].get("CPU") == 4.0
+    assert 0.0 < data["memory"]["usage_fraction"] < 1.0
+
+
+def test_tasks_and_summary(dashboard):
+    assert ray_tpu.get([_work.remote(i) for i in range(4)],
+                       timeout=60) == [1, 2, 3, 4]
+    tasks = _fetch_json(dashboard.port, "/api/tasks")["tasks"]
+    finished = [t for t in tasks if t["state"] == "FINISHED"]
+    assert len(finished) >= 4
+    summary = _fetch_json(dashboard.port, "/api/summary")
+    assert summary["tasks"]["by_state"].get("FINISHED", 0) >= 4
+
+
+def test_actors_endpoint(dashboard):
+    a = _Stateful.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    actors = _fetch_json(dashboard.port, "/api/actors")["actors"]
+    assert any(r["class_name"] == "_Stateful" and r["state"] == "ALIVE"
+               for r in actors)
+
+
+def test_nodes_objects_pgs_workers(dashboard):
+    ref = ray_tpu.put(list(range(100_000)))       # large -> directory entry
+    assert ray_tpu.get(ref, timeout=30)[0] == 0
+    nodes = _fetch_json(dashboard.port, "/api/nodes")["nodes"]
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    objs = _fetch_json(dashboard.port, "/api/objects")["objects"]
+    assert any(o["size"] > 100_000 for o in objs)
+    assert "placement_groups" in _fetch_json(dashboard.port,
+                                             "/api/placement_groups")
+    workers = _fetch_json(dashboard.port, "/api/workers")["workers"]
+    assert len(workers) >= 1
+
+
+def test_html_page_and_404(dashboard):
+    status, body = _fetch(dashboard.port, "/")
+    assert status == 200 and b"ray_tpu dashboard" in body
+    with pytest.raises(urllib.error.HTTPError):
+        _fetch(dashboard.port, "/api/nope")
+
+
+def test_head_process_serves_dashboard():
+    """The process-isolated head starts the dashboard and publishes its
+    address in the cluster KV."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, process_isolated=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        port = cluster.head.ready.get("dashboard_port")
+        assert port
+        data = _fetch_json(port, "/api/cluster")
+        assert data["num_nodes"] >= 1
+        assert _fetch_json(port, "/api/jobs")["jobs"] == []
+    finally:
+        cluster.shutdown()
