@@ -33,6 +33,9 @@ ACTOR_DEAD = "DEAD"
 PG_PENDING = "PENDING"
 PG_CREATED = "CREATED"
 PG_REMOVED = "REMOVED"
+# restored from a previous head's journal: the assigned nodes are dead,
+# so the record is history only, never a placement target
+PG_LOST = "LOST"
 
 
 @dataclass
@@ -98,9 +101,19 @@ class TaskEvent:
 
 
 class GlobalControlPlane:
-    """Thread-safe cluster-wide registries."""
+    """Thread-safe cluster-wide registries.
 
-    def __init__(self):
+    ``storage`` (see ``gcs_storage.py``) makes the durable tables — KV,
+    jobs, placement-group specs — survive a head restart, the role
+    Redis plays for the reference's GCS
+    (``src/ray/gcs/store_client/redis_store_client.h:33``). Volatile
+    state (directory, refcounts, heartbeats) dies with the process that
+    owned it and is rebuilt by re-registration.
+    """
+
+    def __init__(self, storage=None):
+        from . import gcs_storage
+        self._storage = storage or gcs_storage.InMemoryStorage()
         self._lock = threading.RLock()
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorRecord] = {}
@@ -134,6 +147,52 @@ class GlobalControlPlane:
         # in-flight first execution must never be duplicated)
         self._sealed_once: set = set()
         self._reconstruct_claims: Dict[ObjectID, float] = {}
+        self._restore()
+
+    # ------------------------------------------------------- persistence
+    def _restore(self) -> None:
+        """Replay the journal into the durable tables (no-op in-memory)."""
+        for table, op, payload in self._storage.load():
+            if table == "kv":
+                if op == "put" and self._kv_durable(payload[0]):
+                    self.kv[payload[0]] = payload[1]
+                elif op == "del":
+                    self.kv.pop(payload, None)
+            elif table == "jobs" and op == "put":
+                # a job still "running" in the journal died with the old
+                # head (its driver is gone); stamp it finished so it
+                # doesn't show as live forever
+                if payload.end_time is None:
+                    payload.end_time = time.time()
+                self.jobs[payload.job_id] = payload
+            elif table == "pgs":
+                if op == "put":
+                    # the nodes behind the old assignment died with the
+                    # old head: keep the record for history/inspection
+                    # but never as a live placement target
+                    rec = dict(payload)
+                    rec["state"] = "LOST"
+                    self.placement_groups[payload["spec"].pg_id] = rec
+                elif op == "del":
+                    self.placement_groups.pop(payload, None)
+
+    def _durable_snapshot(self) -> list:
+        with self._lock:
+            return ([("kv", "put", (k, v)) for k, v in self.kv.items()
+                     if self._kv_durable(k)]
+                    + [("jobs", "put", r) for r in self.jobs.values()]
+                    + [("pgs", "put", r)
+                       for r in self.placement_groups.values()])
+
+    def compact_storage(self) -> None:
+        # under the plane lock: an append between snapshot and the
+        # journal rename would be destroyed by the rename (a kv_put
+        # that returned True silently losing durability)
+        with self._lock:
+            self._storage.compact(self._durable_snapshot())
+
+    def close_storage(self) -> None:
+        self._storage.close()
 
     # ------------------------------------------------------------- nodes
     def register_node(self, info: NodeInfo) -> None:
@@ -248,24 +307,43 @@ class GlobalControlPlane:
             actor_id = self.named_actors.get((namespace, name))
             return self.actors.get(actor_id) if actor_id else None
 
+    # Durable mutations journal INSIDE the plane lock: an append racing
+    # a later append for the same key would otherwise persist in the
+    # wrong order, and a restart would restore a value the live cluster
+    # never ended on. FileStorage.append is a short local write with its
+    # own lock and never calls back into the plane, so no deadlock.
+
     # -------------------------------------------------------------- jobs
     def register_job(self, rec: JobRecord) -> None:
         with self._lock:
             self.jobs[rec.job_id] = rec
+            self._storage.append(("jobs", "put", rec))
 
     def finish_job(self, job_id: JobID) -> None:
         with self._lock:
             rec = self.jobs.get(job_id)
             if rec:
                 rec.end_time = time.time()
+                self._storage.append(("jobs", "put", rec))
 
     # ---------------------------------------------------------------- kv
+    # never journaled: per-session function blobs (``fn:``, megabytes of
+    # pickled code dead with their job) and runtime discovery keys
+    # (``__rtpu_*`` — a restarted head re-publishes fresh addresses, and
+    # restoring stale ones would point drivers at dead sockets)
+    _VOLATILE_KV_PREFIXES = (b"fn:", b"__rtpu_")
+
+    def _kv_durable(self, key: bytes) -> bool:
+        return not key.startswith(self._VOLATILE_KV_PREFIXES)
+
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> bool:
         with self._lock:
             if not overwrite and key in self.kv:
                 return False
             self.kv[key] = value
-            return True
+            if self._kv_durable(key):
+                self._storage.append(("kv", "put", (key, value)))
+        return True
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
         with self._lock:
@@ -274,6 +352,8 @@ class GlobalControlPlane:
     def kv_del(self, key: bytes) -> None:
         with self._lock:
             self.kv.pop(key, None)
+            if self._kv_durable(key):
+                self._storage.append(("kv", "del", key))
 
     def kv_keys(self, prefix: bytes) -> List[bytes]:
         with self._lock:
@@ -307,10 +387,10 @@ class GlobalControlPlane:
     # ----------------------------------------------------- placement groups
     def register_pg(self, spec: PlacementGroupSpec,
                     assignment: List[NodeID]) -> None:
+        rec = {"spec": spec, "state": PG_CREATED, "assignment": assignment}
         with self._lock:
-            self.placement_groups[spec.pg_id] = {
-                "spec": spec, "state": PG_CREATED, "assignment": assignment,
-            }
+            self.placement_groups[spec.pg_id] = rec
+            self._storage.append(("pgs", "put", rec))
 
     def get_pg(self, pg_id: PlacementGroupID) -> Optional[dict]:
         with self._lock:
@@ -321,7 +401,8 @@ class GlobalControlPlane:
             rec = self.placement_groups.pop(pg_id, None)
             if rec:
                 rec["state"] = PG_REMOVED
-            return rec
+                self._storage.append(("pgs", "del", pg_id))
+        return rec
 
     # ------------------------------------------------- reference counting
     def ref_register(self, oid: ObjectID, holder: tuple) -> None:

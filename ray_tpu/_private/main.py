@@ -46,6 +46,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dashboard-port", type=int, default=0,
                     help="head only: dashboard HTTP port (0 = auto, "
                          "-1 = disabled)")
+    ap.add_argument("--storage", default=None,
+                    help="head only: GCS persistence path (journal file "
+                         "or directory); durable KV/jobs/PG metadata "
+                         "survives a head restart")
     args = ap.parse_args(argv)
 
     if bool(args.head) == bool(args.address):
@@ -68,7 +72,11 @@ def main(argv=None) -> int:
 
     gcs_server = None
     if args.head:
-        plane = GlobalControlPlane()
+        from .gcs_storage import open_storage
+        plane = GlobalControlPlane(storage=open_storage(args.storage))
+        # bound journal growth from the previous life before serving
+        if args.storage:
+            plane.compact_storage()
         gcs_server = GcsServer(plane, port=args.gcs_port)
         gcs = plane
         gcs_port = gcs_server.port
@@ -143,6 +151,8 @@ def main(argv=None) -> int:
             job_rest.stop()
         if gcs_server is not None:
             gcs_server.stop()
+        if args.head:
+            gcs.close_storage()
     return 0
 
 

@@ -32,7 +32,7 @@ from . import protocol as P
 from . import scheduler as sched
 from .config import CONFIG
 from .gcs import (ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING,
-                  GlobalControlPlane, NodeInfo, TaskEvent)
+                  GlobalControlPlane, NodeInfo, PG_LOST, TaskEvent)
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from . import object_store
 from .object_store import ObjectMeta, ObjectStore
@@ -1050,6 +1050,10 @@ class NodeService:
     def _pg_target_node(self, strategy) -> Optional[NodeID]:
         pg = self.gcs.get_pg(strategy.pg_id())
         if pg is None:
+            return None
+        if pg.get("state") == PG_LOST:
+            # journal-restored record: its assignment names nodes that
+            # died with the previous head
             return None
         idx = strategy.placement_group_bundle_index
         assignment = pg["assignment"]
