@@ -193,6 +193,9 @@ def shutdown() -> None:
     client = _ctx.current_client
     if client is None:
         return
+    if CONFIG.tracing_enabled:
+        from .util import tracing as _tracing
+        _tracing.flush()          # ship driver-side spans before detach
     _ctx.current_client = None
     try:
         client.close()
@@ -212,6 +215,9 @@ def shutdown() -> None:
     _global_gcs = None
     _session_dir = None
     _owns_cluster = False
+    # _system_config is session-scoped: the next init() must not inherit
+    # this session's overrides (they'd silently change its behavior)
+    CONFIG.reload()
     atexit.unregister(shutdown)
 
 

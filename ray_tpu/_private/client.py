@@ -463,9 +463,18 @@ class CoreClient:
             scheduling_strategy=scheduling_strategy,
             owner_id=self.worker_id.binary(),
             namespace=self._active_namespace(),
-            runtime_env=runtime_env)
+            runtime_env=runtime_env,
+            trace_context=self._trace_context())
         self._send(P.SUBMIT_TASK, spec)
         return [ObjectRef(oid) for oid in return_ids]
+
+    @staticmethod
+    def _trace_context() -> Optional[dict]:
+        from ..util import tracing
+        return tracing.propagation_context()
+
+    def send_profile_event(self, kind: str, payload) -> None:
+        self._send(P.PROFILE_EVENT, (kind, payload))
 
     def create_actor(self, spec: P.ActorSpec) -> None:
         self._send(P.CREATE_ACTOR, spec)
@@ -484,7 +493,8 @@ class CoreClient:
             return_ids=return_ids, resources={},
             actor_id=actor_id, method_name=method_name, seq_no=seq_no,
             owner_id=self.worker_id.binary(),
-            namespace=self._active_namespace())
+            namespace=self._active_namespace(),
+            trace_context=self._trace_context())
         self._send(P.SUBMIT_ACTOR_TASK, spec)
         return [ObjectRef(oid) for oid in return_ids]
 

@@ -83,6 +83,15 @@ _CONFIG_DEFS: Dict[str, tuple] = {
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
     # --- task events / observability ---
     "task_events_buffer_size": (int, 10000, "ring buffer of task state events"),
+    "cluster_events_buffer_size": (int, 5000,
+                                   "ring buffer of structured cluster "
+                                   "events (reference: event framework, "
+                                   "src/ray/util/event.h)"),
+    "tracing_enabled": (bool, False,
+                        "record spans around task submission/execution "
+                        "with cross-process context propagation "
+                        "(reference: ray.util.tracing)"),
+    "span_buffer_size": (int, 20000, "ring buffer of finished spans"),
     "metrics_report_interval_ms": (int, 5000, "metrics flush period"),
     # --- protocol ---
     "rpc_inline_chunk_bytes": (int, 1 << 20, "frame chunking for large messages"),

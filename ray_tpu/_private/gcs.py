@@ -100,6 +100,37 @@ class TaskEvent:
     is_actor_task: bool = False
 
 
+class _CompactingStorage:
+    """Wraps a GCS storage backend with size-triggered compaction: a
+    long-lived head otherwise grows its journal without bound under
+    KV/job churn (every overwrite appends). Compaction runs inline on
+    the appending thread, already under the plane lock."""
+
+    _COMPACT_EVERY = 20_000
+
+    def __init__(self, inner, plane):
+        self._inner = inner
+        self._plane = plane
+        self._appends = 0
+
+    def append(self, entry) -> None:
+        self._inner.append(entry)
+        self._appends += 1
+        if self._appends >= self._COMPACT_EVERY:
+            self._appends = 0
+            self._inner.compact(self._plane._durable_snapshot())
+
+    def load(self):
+        return self._inner.load()
+
+    def compact(self, snapshot) -> None:
+        self._appends = 0
+        self._inner.compact(snapshot)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 class GlobalControlPlane:
     """Thread-safe cluster-wide registries.
 
@@ -113,7 +144,8 @@ class GlobalControlPlane:
 
     def __init__(self, storage=None):
         from . import gcs_storage
-        self._storage = storage or gcs_storage.InMemoryStorage()
+        self._storage = _CompactingStorage(
+            storage or gcs_storage.InMemoryStorage(), self)
         self._lock = threading.RLock()
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorRecord] = {}
@@ -124,6 +156,9 @@ class GlobalControlPlane:
         # object directory: object -> (node_id, meta)
         self.directory: Dict[ObjectID, Tuple[NodeID, ObjectMeta]] = {}
         self.task_events: deque = deque(maxlen=CONFIG.task_events_buffer_size)
+        self.cluster_events: deque = deque(
+            maxlen=CONFIG.cluster_events_buffer_size)
+        self.spans: deque = deque(maxlen=CONFIG.span_buffer_size)
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
         # distributed reference counting (reference: reference_count.h:61):
         # holder = (node_id_bin, conn_key) — one entry per process holding
@@ -561,6 +596,23 @@ class GlobalControlPlane:
     def list_task_events(self, limit: int = 1000) -> List[TaskEvent]:
         with self._lock:
             return list(self.task_events)[-limit:]
+
+    # --------------------------------------- structured events + spans
+    def record_cluster_event(self, rec: dict) -> None:
+        with self._lock:
+            self.cluster_events.append(rec)
+
+    def list_cluster_events(self, limit: int = 1000) -> List[dict]:
+        with self._lock:
+            return list(self.cluster_events)[-limit:]
+
+    def record_spans(self, spans: List[dict]) -> None:
+        with self._lock:
+            self.spans.extend(spans)
+
+    def list_spans(self, limit: int = 10000) -> List[dict]:
+        with self._lock:
+            return list(self.spans)[-limit:]
 
     # ------------------------------------------------------------- pubsub
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:

@@ -38,6 +38,8 @@ _ALLOWED = frozenset({
     "actors_snapshot", "directory_snapshot", "pgs_snapshot",
     "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
     "unpin_task_args", "record_lineage", "get_lineage", "claim_lineage",
+    "record_cluster_event", "list_cluster_events",
+    "record_spans", "list_spans",
 })
 
 
@@ -193,6 +195,7 @@ class RemoteControlPlane:
         "record_task_event", "publish", "kv_del", "finish_job",
         "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
         "unpin_task_args", "record_lineage",
+        "record_cluster_event", "record_spans",
     })
 
     def __init__(self, address: str):

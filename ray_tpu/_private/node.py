@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions
+from . import events
 from . import memory_monitor
 from . import protocol as P
 from . import scheduler as sched
@@ -319,6 +320,10 @@ class NodeService:
         self.shm_probe_path: Optional[str] = None
         self.shm_probe_token: Optional[str] = None
 
+        # structured lifecycle events (reference: src/ray/util/event.h)
+        self.events = events.EventLogger(session_dir, self.node_id.hex(),
+                                         gcs=gcs)
+
         self._rng = random.Random(self.node_id.binary())
 
     # ----------------------------------------------------------- lifecycle
@@ -400,6 +405,9 @@ class NodeService:
                            CONFIG.maximum_startup_concurrency - 2))
         for _ in range(n_pre):
             self._spawn_worker()
+        self.events.info("NODE_START", "node service started",
+                         resources=dict(self.resources_total),
+                         address=self.tcp_address or self.socket_path)
 
     def stop(self, kill_workers: bool = True) -> None:
         if self._stopped.is_set():
@@ -602,6 +610,12 @@ class NodeService:
               f"{CONFIG.memory_usage_threshold:.0%}; killing worker "
               f"pid={pid} ({snap['available_bytes']>>20} MiB avail)",
               file=sys.stderr)
+        self.events.warning(
+            "OOM_KILL", "memory monitor killed a worker to relieve "
+            "node memory pressure", pid=pid,
+            usage_fraction=round(frac, 3),
+            task=(victim.task.spec.name if victim.task else None),
+            actor_id=(victim.actor_id.hex() if victim.actor_id else None))
         try:
             if victim.proc is not None:
                 victim.proc.kill()
@@ -878,6 +892,13 @@ class NodeService:
             self._create_actor(payload)
         elif op == P.SUBMIT_ACTOR_TASK:
             self._submit_actor_task(payload)
+        elif op == P.PROFILE_EVENT:
+            ev_kind, ev_payload = payload
+            if ev_kind == "spans":
+                try:
+                    self.gcs.record_spans(ev_payload)
+                except Exception:   # noqa: BLE001 — tracing is best-effort
+                    pass
         elif op == P.GET_OBJECTS:
             self._get_objects(key, *payload)
         elif op == P.GET_OBJECTS_FETCH:
@@ -1476,6 +1497,12 @@ class NodeService:
                 del self._workers[wid]
                 self._num_starting = max(0, self._num_starting - 1)
                 if died or w.env_setup:
+                    self.events.error(
+                        "WORKER_START_FAILURE",
+                        "worker died before registering" if died else
+                        "runtime env setup timed out",
+                        env_key=w.env_key,
+                        pid=w.proc.pid if w.proc else None)
                     # Processes that exited on their own count toward the
                     # env failure budget — a slow registration (killed at
                     # the timeout) is load, not a broken env, and must
@@ -1508,6 +1535,10 @@ class NodeService:
         # disable TPU-attach hooks in sitecustomize (saves ~2s/spawn).
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
+        if CONFIG.tracing_enabled:
+            # workers read config from env; the driver's _system_config
+            # reload doesn't reach their processes
+            env["RTPU_TRACING_ENABLED"] = "1"
         cwd = os.getcwd()
         if worker_runtime_env:
             overrides, env_cwd = renv.stage(worker_runtime_env,
@@ -1863,6 +1894,9 @@ class NodeService:
         if st is None:
             return
         can_restart = (st["restarts_left"] != 0) and not st["no_restart"]
+        self.events.emit(
+            "WARNING" if can_restart else "ERROR", "ACTOR_DEATH", reason,
+            actor_id=actor_id.hex(), will_restart=can_restart)
         # fail tasks currently running on the actor
         for tid, rec in list(self._running.items()):
             if rec.spec.actor_id == actor_id:
@@ -2191,6 +2225,12 @@ class NodeService:
         that died (reference: lease failure + ``RetryTaskIfPossible``), and
         rebuild lost objects that local waiters/deps still need
         (``object_recovery_manager.h:90``)."""
+        # every surviving node observes the same death: only the node
+        # co-located with the control plane publishes it cluster-wide
+        self.events.warning("NODE_DEATH", "peer node died",
+                            dead_node_id=node_id.hex(),
+                            local_only=not isinstance(
+                                self.gcs, GlobalControlPlane))
         peer = self._peers.pop(node_id, None)
         if peer is not None:
             peer.close()
@@ -2298,6 +2338,12 @@ class NodeService:
                      "bundles": rec["spec"].bundles,
                      "strategy": rec["spec"].strategy}
                     for pid, rec in self.gcs.pgs_snapshot()]
+        if what == "cluster_events":
+            # full ring: the state API applies filters BEFORE its limit,
+            # so a server-side cap would hide older matching rows
+            return self.gcs.list_cluster_events(limit=10**9)
+        if what == "spans":
+            return self.gcs.list_spans(limit=10**9)
         return None
 
     def _record_event(self, spec: P.TaskSpec, state: str) -> None:

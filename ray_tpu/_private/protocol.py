@@ -128,6 +128,9 @@ class TaskSpec:
     owner_id: bytes = b""                    # WorkerID binary of the submitter
     namespace: str = "default"               # submitter's job namespace
     runtime_env: Optional[dict] = None       # validated runtime env
+    # tracing: caller's (trace_id, span_id), propagated into the worker
+    # (reference: ray.util.tracing traceparent in the task spec)
+    trace_context: Optional[dict] = None
 
 
 @dataclass

@@ -113,36 +113,70 @@ class WorkerRuntime:
         # concurrent calls on a threaded actor don't race each other)
         context.current_namespace.set(
             actor_spec.namespace if actor_spec else spec.namespace)
+        span_cm = self._task_span(kind, spec)
         try:
-            if kind == "task":
-                fn = self._get_function(spec.function_id)
-                args, kwargs = self._load_args(spec, deps)
-                result = fn(*args, **kwargs)
-            elif kind == "actor_create":
-                result = self._create_actor(actor_spec, spec, deps)
-            else:  # actor_call
-                args, kwargs = self._load_args(spec, deps)
-                method = getattr(self._actor_instance, spec.method_name)
-                result = method(*args, **kwargs)
-                if inspect.iscoroutine(result):
-                    # sync actor defining an async method: run it here
-                    result = asyncio.new_event_loop().run_until_complete(result)
+            with span_cm:
+                if kind == "task":
+                    fn = self._get_function(spec.function_id)
+                    args, kwargs = self._load_args(spec, deps)
+                    result = fn(*args, **kwargs)
+                elif kind == "actor_create":
+                    result = self._create_actor(actor_spec, spec, deps)
+                else:  # actor_call
+                    args, kwargs = self._load_args(spec, deps)
+                    method = getattr(self._actor_instance, spec.method_name)
+                    result = method(*args, **kwargs)
+                    if inspect.iscoroutine(result):
+                        # sync actor defining an async method: run it here
+                        result = asyncio.new_event_loop(
+                        ).run_until_complete(result)
             self._send_done(spec, kind, result, None)
         except BaseException as e:  # noqa: BLE001
             self._send_done(spec, kind, None, e)
         finally:
             context.current_task_id = None
+            # don't leak this task's trace into spans a later codepath
+            # might open on the same pool thread
+            from ..util import tracing
+            tracing.set_remote_parent(None)
+
+    @staticmethod
+    def _task_span(kind: str, spec: P.TaskSpec):
+        """Span around execution, parented to the submitter's context
+        carried in the spec (no-op context manager when neither this
+        process nor the submitter is tracing). A non-None trace_context
+        — even an empty one — means the SUBMITTER had tracing on, which
+        overrides this node's own config (remote nodes never see the
+        driver's _system_config)."""
+        from ..util import tracing
+        if not (tracing.enabled() or spec.trace_context is not None):
+            import contextlib
+            return contextlib.nullcontext()
+        tracing.set_remote_parent(spec.trace_context or None)
+        return tracing.start_span(
+            f"{kind}::{spec.name}",
+            attributes={"task_id": spec.task_id.hex()}, force=True)
 
     async def _run_async(self, spec: P.TaskSpec, deps) -> None:
         context.current_namespace.set(spec.namespace)
+        # stackless span: concurrent async calls interleave on one loop
+        # thread, so the thread-local span stack would mis-nest them
+        from ..util import tracing
+        span = None
+        if tracing.enabled() or spec.trace_context is not None:
+            span = tracing.begin_span(
+                f"actor_call::{spec.name}", spec.trace_context or None,
+                attributes={"task_id": spec.task_id.hex()})
         try:
             args, kwargs = self._load_args(spec, deps)
             method = getattr(self._actor_instance, spec.method_name)
             result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
+            tracing.end_span(span)
             self._send_done(spec, "actor_call", result, None)
         except BaseException as e:  # noqa: BLE001
+            tracing.end_span(span, error=type(e).__name__)
             self._send_done(spec, "actor_call", None, e)
 
     def _create_actor(self, actor_spec: P.ActorSpec, spec: P.TaskSpec,
@@ -228,6 +262,10 @@ class WorkerRuntime:
         # node unpins this task's args (same conn => ordered frames)
         self.client.flush_refs()
         self.conn.send((P.TASK_DONE, (spec.task_id, metas, err_bytes, kind)))
+        # unconditional: force-traced spans exist even when THIS node's
+        # config has tracing off (flush is a no-op on an empty buffer)
+        from ..util import tracing
+        tracing.flush()
 
     def _store_return(self, oid: ObjectID, value: Any) -> ObjectMeta:
         smeta, views = ser.serialize(value)

@@ -140,6 +140,53 @@ def summarize_actors() -> Dict[str, Any]:
     return summarize_actor_rows(list_actors(limit=10**9))
 
 
+def list_cluster_events(filters: Optional[dict] = None,
+                        limit: int = 1000) -> List[dict]:
+    """Structured lifecycle events — node up/down, OOM kills, actor
+    deaths (reference: ``ray list cluster-events``)."""
+    rows = _query("cluster_events") or []
+    return _apply_filters(rows, filters)[-limit:]
+
+
+def list_spans(filters: Optional[dict] = None,
+               limit: int = 10000) -> List[dict]:
+    """Finished trace spans (requires ``tracing_enabled``)."""
+    # ship this process's own buffered spans first, so driver-side
+    # spans are visible mid-session (not only after shutdown)
+    from ..util import tracing
+    tracing.flush()
+    rows = _query("spans") or []
+    return _apply_filters(rows, filters)[-limit:]
+
+
+def trace_timeline(filename: Optional[str] = None) -> Any:
+    """Chrome-trace JSON built from SPANS (cross-process causality via
+    trace/parent ids; requires ``tracing_enabled``). Complement of
+    ``timeline()``, which is built from task state events."""
+    trace = []
+    for span in list_spans():
+        if span.get("end_time") is None:
+            continue
+        trace.append({
+            "name": span["name"],
+            "cat": "span",
+            "ph": "X",
+            "ts": span["start_time"] * 1e6,
+            "dur": (span["end_time"] - span["start_time"]) * 1e6,
+            "pid": f"trace:{span['trace_id'][:8]}",
+            "tid": f"pid:{span.get('pid', '?')}",
+            "args": {"span_id": span["span_id"],
+                     "parent_id": span.get("parent_id"),
+                     "status": span.get("status"),
+                     **span.get("attributes", {})},
+        })
+    if filename is not None:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+        return filename
+    return trace
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Chrome-trace JSON of task execution (reference: ``ray.timeline``,
     ``_private/state.py:865``). Load the output in chrome://tracing or
