@@ -14,6 +14,7 @@ import hashlib
 import threading
 from collections import deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions
@@ -324,12 +325,34 @@ class CoreClient:
         return (P.GET_OBJECTS_FETCH if self.wire_data_plane
                 else P.GET_OBJECTS)
 
+    def _blocking_result(self, fut: Future):
+        """Await a get/wait reply; a worker mid-task that actually has
+        to WAIT tells its node first, so the node returns the task's CPU
+        and the children being waited on can run (reference:
+        ``NotifyDirectCallTaskBlocked`` — without this, nested
+        submission deadlocks once parents hold every CPU). The short
+        probe keeps already-ready gets off the notify path."""
+        from . import context as _ctx
+        in_task = (self.kind == P.KIND_WORKER
+                   and _ctx.current_task_id is not None)
+        if not in_task:
+            return fut.result()
+        try:
+            return fut.result(timeout=0.004)
+        except FuturesTimeout:
+            pass
+        self._send(P.NOTIFY_BLOCKED, None)
+        try:
+            return fut.result()
+        finally:
+            self._send(P.NOTIFY_UNBLOCKED, None)
+
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         ids = [r.id for r in refs]
         fut = self._request(self._get_op,
                             lambda rid: (rid, ids, timeout))
-        metas = fut.result()
+        metas = self._blocking_result(fut)
         out = []
         for ref, m in zip(refs, metas):
             out.append(self._load_meta(ref, m, timeout))
@@ -366,7 +389,7 @@ class CoreClient:
         ids = [r.id for r in refs]
         fut = self._request(P.WAIT_OBJECTS,
                             lambda rid: (rid, ids, num_returns, timeout))
-        ready_ids, pending_ids = fut.result()
+        ready_ids, pending_ids = self._blocking_result(fut)
         ready_set = set(ready_ids)
         ready = [r for r in refs if r.id in ready_set]
         pending = [r for r in refs if r.id not in ready_set]

@@ -92,6 +92,11 @@ class _TaskRecord:
     # stores actually pinned at dispatch, so unpin hits the same store
     # even if the object's directory entry changes mid-task
     pinned_stores: Dict[ObjectID, Any] = field(default_factory=dict)
+    # count of worker threads currently blocked in a get(); the CPU
+    # charge is returned to the pool while > 0 (a bool would mispair
+    # when a task's user threads block concurrently — the first
+    # unblock would re-charge while others still wait)
+    blocked_depth: int = 0
 
 
 @dataclass
@@ -892,6 +897,10 @@ class NodeService:
             self._create_actor(payload)
         elif op == P.SUBMIT_ACTOR_TASK:
             self._submit_actor_task(payload)
+        elif op == P.NOTIFY_BLOCKED:
+            self._worker_blocked(key)
+        elif op == P.NOTIFY_UNBLOCKED:
+            self._worker_unblocked(key)
         elif op == P.PROFILE_EVENT:
             ev_kind, ev_payload = payload
             if ev_kind == "spans":
@@ -1351,14 +1360,63 @@ class NodeService:
     def _release_charge(self, rec: _TaskRecord) -> None:
         if rec.charge is None:
             return
+        charge = dict(rec.charge)
+        if rec.blocked_depth > 0:
+            # the CPU portion was already returned when the worker
+            # blocked in get(); releasing it again would mint capacity
+            charge.pop("CPU", None)
+            rec.blocked_depth = 0
         with self._res_lock:
-            if rec.pg_key is not None:
-                pool = self.pg_reservations.get(rec.pg_key)
-                if pool is not None:
-                    sched.add(pool, rec.charge)
-            else:
-                sched.add(self.resources_available, rec.charge)
+            pool = self._rec_charge_pool(rec)
+            if pool is not None:
+                sched.add(pool, charge)
         rec.charge = None
+
+    def _rec_charge_pool(self, rec: _TaskRecord):
+        if rec.pg_key is not None:
+            return self.pg_reservations.get(rec.pg_key)
+        return self.resources_available
+
+    def _worker_blocked(self, conn_key: int) -> None:
+        """A worker entered a blocking get(): return its CPU so the
+        tasks it waits on can be scheduled here — otherwise nested
+        submission deadlocks once parents hold every CPU (reference:
+        ``NotifyDirectCallTaskBlocked``)."""
+        wid = self._conn_worker.get(conn_key)
+        w = self._workers.get(wid) if wid is not None else None
+        rec = w.task if w is not None else None
+        if rec is None or rec.charge is None:
+            return
+        cpu = rec.charge.get("CPU", 0.0)
+        if not cpu:
+            return
+        rec.blocked_depth += 1
+        if rec.blocked_depth > 1:
+            return                  # CPU already returned
+        with self._res_lock:
+            pool = self._rec_charge_pool(rec)
+            if pool is not None:
+                sched.add(pool, {"CPU": cpu})
+        self._dispatch()
+
+    def _worker_unblocked(self, conn_key: int) -> None:
+        wid = self._conn_worker.get(conn_key)
+        w = self._workers.get(wid) if wid is not None else None
+        rec = w.task if w is not None else None
+        if rec is None or rec.charge is None or rec.blocked_depth == 0:
+            return
+        rec.blocked_depth -= 1
+        if rec.blocked_depth > 0:
+            return                  # other threads still blocked
+        cpu = rec.charge.get("CPU", 0.0)
+        with self._res_lock:
+            pool = self._rec_charge_pool(rec)
+            if pool is not None:
+                # may drive availability transiently negative: the
+                # resumed task runs NOW regardless, and new dispatch
+                # just waits for real capacity (same oversubscription
+                # the reference accepts on unblock)
+                sched.subtract(pool, {"CPU": cpu})
 
     def _rec_env_key(self, rec: "_TaskRecord") -> str:
         from . import runtime_env as renv
@@ -1392,7 +1450,15 @@ class NodeService:
                             ) -> None:
         self._reap_startup_failures()
         env_key = self._rec_env_key(rec) if rec is not None else ""
-        active = sum(1 for w in self._workers.values() if w.state != "DEAD")
+        # workers blocked in a get() don't count against the pool cap:
+        # deep nested submission (recursion) parks a worker per level,
+        # and capping on them deadlocks the leaves that would unblock
+        # them (reference: WorkerPool grows past the cap while direct
+        # call workers are blocked)
+        active = sum(1 for w in self._workers.values()
+                     if w.state != "DEAD"
+                     and not (w.task is not None
+                              and w.task.blocked_depth > 0))
         if active >= self._max_workers:
             # pool full of other-env workers would starve this env forever;
             # evict one idle mismatched worker to make room (reference:

@@ -79,6 +79,11 @@ GET_OBJECTS_FETCH = 52  # (req_id, [ObjectID], timeout) — GET_REPLY metas
 PUT_OBJECT_WIRE = 53    # (req_id, ObjectID, bytes) — node materializes
                         # the payload in ITS store and seals
 
+# Worker blocked in a get(): release its CPU so nested tasks can run
+# (reference: NotifyDirectCallTaskBlocked/Unblocked, core_worker.cc)
+NOTIFY_BLOCKED = 54     # no payload
+NOTIFY_UNBLOCKED = 55   # no payload
+
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
 GET_REPLY = 41          # (req_id, [ObjectMeta])

@@ -116,16 +116,27 @@ class RemoteFunction:
             f"use {self._name}.remote(...)")
 
     def __reduce__(self):
-        # RemoteFunction objects are captured by closures shipped to workers;
-        # pickle as (blob, options) so the lock never crosses the wire
-        with self._lock:
-            if self._function_id is None:
-                self._blob = ser.dumps_function(self._fn)
-                self._function_id = function_id_of(self._blob)
-        return (_rebuild_remote_function, (self._blob, self._options))
+        # Exported already: ship the cached blob (plain-pickle-friendly,
+        # keeps one function id across processes). NOT exported yet —
+        # which includes mid-export, when a recursive function's closure
+        # reaches back to itself — pickle the RAW function inside the
+        # ENCLOSING dump: a nested dump here would deadlock on
+        # self._lock and then recurse forever, while the enclosing
+        # pickler's memo handles the closure cycle fine. The rebuilt
+        # instance re-exports lazily on first .remote().
+        blob = self._blob
+        if blob is not None:
+            return (_rebuild_remote_function_blob,
+                    (blob, self._options))
+        return (_rebuild_remote_function, (self._fn, self._options))
 
 
-def _rebuild_remote_function(blob: bytes, options: dict) -> "RemoteFunction":
+def _rebuild_remote_function(fn, options: dict) -> "RemoteFunction":
+    return RemoteFunction(fn, **options)
+
+
+def _rebuild_remote_function_blob(blob: bytes,
+                                  options: dict) -> "RemoteFunction":
     rf = RemoteFunction(ser.loads_function(blob), **options)
     rf._blob = blob
     rf._function_id = function_id_of(blob)

@@ -242,3 +242,36 @@ def test_spilled_object_cross_node(rtpu_cluster):
 
     got = ray_tpu.get(consume.remote(ref), timeout=30)
     assert got == int(np.arange(512 * 1024, dtype=np.uint8).sum())
+
+
+@ray_tpu.remote
+def _nested_child(x):
+    return x * 2
+
+
+@ray_tpu.remote
+def _nested_parent(x):
+    # blocks in get() while holding a CPU; the node must release it so
+    # the child can run (reference: NotifyDirectCallTaskBlocked)
+    return ray_tpu.get(_nested_child.remote(x)) + 1
+
+
+def test_nested_tasks_saturating_cpus_no_deadlock():
+    ray_tpu.init(num_cpus=2)
+    try:
+        # both CPUs held by parents; children only run because blocked
+        # parents return their CPUs
+        out = ray_tpu.get([_nested_parent.remote(i) for i in range(2)],
+                          timeout=60)
+        assert out == [1, 3]
+        # deeper: a chain parent -> child -> grandchild on ONE cpu
+        @ray_tpu.remote
+        def chain(depth):
+            if depth == 0:
+                return 0
+            return ray_tpu.get(chain.remote(depth - 1)) + 1
+
+        assert ray_tpu.get(chain.options(num_cpus=2).remote(3),
+                           timeout=60) == 3
+    finally:
+        ray_tpu.shutdown()
