@@ -1619,8 +1619,25 @@ class NodeService:
         fw_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         pp = env.get("PYTHONPATH", "")
-        if fw_root not in pp.split(os.pathsep):
-            env["PYTHONPATH"] = (pp + os.pathsep if pp else "") + fw_root
+        have = set(pp.split(os.pathsep))
+        # Workers resolve by-reference pickles (plain functions/classes
+        # passed as args) against the USER-LEVEL import paths of this
+        # node's process — locally the driver's script dir, so a
+        # function from the user's script module imports inside the
+        # worker (reference: same-node workers share the job's
+        # environment). Site-packages/stdlib dirs are excluded (they'd
+        # shadow a pip runtime-env venv's pinned packages), and a staged
+        # working_dir opts out entirely — its snapshot must stay
+        # hermetic, not fall through to live driver directories.
+        extra = []
+        if not (worker_runtime_env
+                and "working_dir" in worker_runtime_env):
+            extra = [p for p in _user_sys_paths() if p not in have]
+        if fw_root not in have and fw_root not in extra:
+            extra.append(fw_root)
+        if extra:
+            env["PYTHONPATH"] = ((pp + os.pathsep if pp else "")
+                                 + os.pathsep.join(extra))
         # pip envs go through the bootstrap, which builds/reuses a cached
         # venv in the worker process (never blocking this dispatcher) and
         # execs the real worker under the venv interpreter
@@ -2417,6 +2434,34 @@ class NodeService:
             task_id=spec.task_id, name=spec.name, state=state,
             node_id=self.node_id, timestamp=time.time(),
             is_actor_task=spec.actor_id is not None))
+
+
+def _user_sys_paths() -> List[str]:
+    """sys.path entries added by the user/driver (script dir, cwd,
+    test dirs) — interpreter-owned dirs (stdlib, site-packages) are
+    excluded so they never shadow a pip runtime-env venv."""
+    import site
+    import sysconfig
+
+    interp = set()
+    for key in ("stdlib", "platstdlib", "purelib", "platlib"):
+        try:
+            interp.add(os.path.realpath(sysconfig.get_paths()[key]))
+        except KeyError:
+            pass
+    for p in site.getsitepackages() + [site.getusersitepackages()]:
+        interp.add(os.path.realpath(p))
+    out = []
+    for p in sys.path:
+        if not p or not os.path.isdir(p):
+            continue
+        rp = os.path.realpath(p)
+        if any(rp == d or rp.startswith(d + os.sep) for d in interp):
+            continue
+        if rp.startswith(os.path.realpath(sys.prefix) + os.sep):
+            continue
+        out.append(p)
+    return out
 
 
 class ActorTaskIds:
