@@ -77,8 +77,12 @@ class _Coordinator:
         if op is None:            # allgather / barrier: list of parts
             rec["result"] = parts
         else:
-            rec["result"] = _REDUCERS[op](np.stack(
-                [np.asarray(p) for p in parts]))
+            stacked = np.stack([np.asarray(p) for p in parts])
+            # keep the contribution dtype: np.sum promotes int32->int64,
+            # but collectives contract to return what was put in (NCCL
+            # semantics)
+            rec["result"] = _REDUCERS[op](stacked).astype(
+                stacked.dtype, copy=False)
         rec["done"] = True
         rec["acks"] = set()
         return True, rec["result"]

@@ -96,3 +96,127 @@ def test_mesh_group(rtpu_init):
     assert group.run("rank_and_world") == [(0, 2), (1, 2)]
     assert group.run("compute", 10) == [10, 20]
     group.shutdown()
+
+
+def _make_full_worker():
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.comm import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class Full(col.CollectiveActorMixin):
+        def ar(self, x, op, group="default"):
+            return col.allreduce(np.asarray(x), op=op, group_name=group)
+
+        def barrier_then_time(self, sleep_s, group="default"):
+            _time.sleep(sleep_s)
+            col.barrier(group_name=group)
+            return _time.monotonic()
+
+        def shaped(self, arr):
+            out = col.allreduce(np.asarray(arr))
+            return out.shape, out.dtype.str, out
+
+        def destroy(self, group="default"):
+            col.destroy_collective_group(group)
+            return True
+
+    return Full
+
+
+def test_allreduce_op_variants(rtpu_init):
+    from ray_tpu.comm import collective as col
+    Full = _make_full_worker()
+    members = [Full.remote() for _ in range(3)]
+    col.create_collective_group(members, 3, [0, 1, 2])
+
+    outs = ray_tpu.get([m.ar.remote([float(i + 1)], col.MAX)
+                        for i, m in enumerate(members)])
+    for arr in outs:
+        np.testing.assert_allclose(arr, [3.0])
+    outs = ray_tpu.get([m.ar.remote([float(i + 1)], col.MIN)
+                        for i, m in enumerate(members)])
+    for arr in outs:
+        np.testing.assert_allclose(arr, [1.0])
+    outs = ray_tpu.get([m.ar.remote([float(i + 1)], col.PROD)
+                        for i, m in enumerate(members)])
+    for arr in outs:
+        np.testing.assert_allclose(arr, [6.0])
+
+
+def test_barrier_synchronizes(rtpu_init):
+    import time as _time
+
+    from ray_tpu.comm import collective as col
+    Full = _make_full_worker()
+    members = [Full.remote() for _ in range(3)]
+    col.create_collective_group(members, 3, [0, 1, 2])
+    t0 = _time.monotonic()
+    times = ray_tpu.get([m.barrier_then_time.remote(0.1 * i)
+                         for i, m in enumerate(members)], timeout=60)
+    # nobody may pass the barrier before the slowest member arrives
+    assert min(times) - t0 >= 0.2 - 0.05
+
+
+def test_dtypes_and_shapes_preserved(rtpu_init):
+    from ray_tpu.comm import collective as col
+    Full = _make_full_worker()
+    members = [Full.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1])
+    arr = np.arange(12, dtype=np.int32).reshape(3, 4)
+    outs = ray_tpu.get([m.shaped.remote(arr) for m in members])
+    for shape, dtype, out in outs:
+        assert tuple(shape) == (3, 4)
+        assert np.dtype(dtype) == np.int32
+        np.testing.assert_array_equal(out, arr * 2)
+
+    arr64 = np.ones(5, dtype=np.float64) * 0.5
+    outs = ray_tpu.get([m.shaped.remote(arr64) for m in members])
+    for shape, dtype, out in outs:
+        assert np.dtype(dtype) == np.float64
+        np.testing.assert_allclose(out, np.ones(5))
+
+
+def test_two_independent_groups(rtpu_init):
+    from ray_tpu.comm import collective as col
+    Full = _make_full_worker()
+    a = [Full.remote() for _ in range(2)]
+    b = [Full.remote() for _ in range(2)]
+    col.create_collective_group(a, 2, [0, 1], group_name="ga")
+    col.create_collective_group(b, 2, [0, 1], group_name="gb")
+    outs_a = ray_tpu.get([m.ar.remote([1.0], col.SUM, "ga") for m in a])
+    outs_b = ray_tpu.get([m.ar.remote([10.0], col.SUM, "gb") for m in b])
+    for arr in outs_a:
+        np.testing.assert_allclose(arr, [2.0])
+    for arr in outs_b:
+        np.testing.assert_allclose(arr, [20.0])
+
+
+def test_group_validation_errors(rtpu_init):
+    import pytest
+
+    from ray_tpu.comm import collective as col
+    Full = _make_full_worker()
+    members = [Full.remote() for _ in range(2)]
+    with pytest.raises(ValueError):
+        col.create_collective_group(members, 3, [0, 1, 2])
+    with pytest.raises(ValueError):
+        col.create_collective_group(members, 2, [0, 2])
+
+
+def test_destroy_and_recreate_group(rtpu_init):
+    from ray_tpu.comm import collective as col
+    Full = _make_full_worker()
+    members = [Full.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1], group_name="cycle")
+    outs = ray_tpu.get([m.ar.remote([2.0], col.SUM, "cycle")
+                        for m in members])
+    np.testing.assert_allclose(outs[0], [4.0])
+    ray_tpu.get([m.destroy.remote("cycle") for m in members])
+    # same name, fresh membership
+    fresh = [Full.remote() for _ in range(2)]
+    col.create_collective_group(fresh, 2, [0, 1], group_name="cycle")
+    outs = ray_tpu.get([m.ar.remote([5.0], col.SUM, "cycle")
+                        for m in fresh])
+    np.testing.assert_allclose(outs[0], [10.0])
