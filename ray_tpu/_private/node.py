@@ -99,6 +99,80 @@ class _TaskRecord:
     blocked_depth: int = 0
 
 
+class _PendingQueue:
+    """Ready-to-dispatch tasks bucketed by scheduling shape
+    (pg, resources, env).
+
+    Dispatch cost per event is O(#distinct shapes + #assigned) instead
+    of O(#pending): a shape that fails to fit blocks only its own
+    bucket, and a 10k-task burst of one shape is a single head probe —
+    the flat-deque scan made every completion O(pending) and bursts
+    O(pending²) (reference analogue: schedulable-queue buckets per
+    resource shape, ``cluster_task_manager.cc``)."""
+
+    def __init__(self, env_key_fn):
+        self._by_shape: Dict[tuple, deque] = {}
+        self._env_key_fn = env_key_fn
+        self._n = 0
+        self._seq = 0
+
+    def append(self, rec: "_TaskRecord") -> None:
+        shape = (rec.pg_key,
+                 tuple(sorted(rec.spec.resources.items())),
+                 self._env_key_fn(rec))
+        rec._pending_shape = shape
+        self._seq += 1
+        rec._pending_seq = self._seq
+        q = self._by_shape.get(shape)
+        if q is None:
+            q = self._by_shape[shape] = deque()
+        q.append(rec)
+        self._n += 1
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __iter__(self):
+        for q in list(self._by_shape.values()):
+            yield from q
+
+    def shapes(self) -> list:
+        """Shapes ordered by their OLDEST member, so freed capacity goes
+        to the longest-waiting task first (global-FIFO-like fairness —
+        a continuously fed bucket must not starve the others)."""
+        return sorted(
+            (s for s, q in self._by_shape.items() if q),
+            key=lambda s: self._by_shape[s][0]._pending_seq)
+
+    def bucket(self, shape) -> deque:
+        return self._by_shape.get(shape) or deque()
+
+    def popleft(self, shape) -> "_TaskRecord":
+        rec = self._by_shape[shape].popleft()
+        self._n -= 1
+        return rec
+
+    def remove(self, rec: "_TaskRecord") -> bool:
+        """Purge a (cancelled) record wherever it sits in its bucket."""
+        shape = getattr(rec, "_pending_shape", None)
+        q = self._by_shape.get(shape)
+        if q is None:
+            return False
+        try:
+            q.remove(rec)
+        except ValueError:
+            return False
+        self._n -= 1
+        if not q:
+            del self._by_shape[shape]
+        return True
+
+    def drop_empty(self, shape) -> None:
+        q = self._by_shape.get(shape)
+        if q is not None and not q:
+            del self._by_shape[shape]
+
+
 @dataclass
 class _OwnedTask:
     """Owner-side record of a submitted task, for retry on node failure.
@@ -276,7 +350,7 @@ class NodeService:
         self._env_spawn_failures: Dict[str, int] = {}
         self._env_spawn_error: Dict[str, str] = {}
 
-        self._pending: deque = deque()                    # ready-to-dispatch
+        self._pending = _PendingQueue(self._rec_env_key)  # ready-to-dispatch
         self._waiting_deps: Dict[TaskID, _TaskRecord] = {}
         self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
         self._running: Dict[TaskID, _TaskRecord] = {}
@@ -1266,58 +1340,57 @@ class NodeService:
         ``local_task_manager.cc:105``)."""
         if not self._pending:
             return
-        remaining = deque()
         failed_envs: Set[str] = set()
-        # once a (pg, resource-shape) fails to acquire, every later task
-        # with the same shape fails too — skip them instead of rescanning
-        # (keeps dispatch O(pending) per event, not O(pending²) per batch)
-        failed_shapes: Set[tuple] = set()
         starved_envs: Set[str] = set()
-        while self._pending:
-            rec = self._pending.popleft()
-            if rec.cancelled:
-                continue
-            shape = (rec.pg_key,
-                     tuple(sorted(rec.spec.resources.items())))
-            if shape in failed_shapes:
-                remaining.append(rec)
-                continue
-            if not self._try_acquire(rec):
-                failed_shapes.add(shape)
-                remaining.append(rec)
-                continue
-            env_key = self._rec_env_key(rec)
-            if env_key in starved_envs:
-                self._release_charge(rec)
-                remaining.append(rec)
-                # skip the idle-deque rescan but still request a spawn —
-                # cold-start ramp must stay parallel up to the startup
-                # concurrency cap, not one worker per dispatch pass
-                self._maybe_spawn_worker(rec)
-                continue
-            wid = self._acquire_worker(env_key)
-            if wid is None:
-                self._release_charge(rec)
-                if (self._env_spawn_failures.get(env_key, 0)
-                        >= CONFIG.worker_startup_max_failures):
-                    failed_envs.add(env_key)
-                    # workers for this env die on startup repeatedly —
-                    # fail fast instead of pending forever (reference:
-                    # PopWorker status callback, ``worker_pool.h:152``)
-                    self._fail_pending_rec(rec, exceptions.RuntimeEnvSetupError(
-                        f"workers for task {rec.spec.name!r} failed to "
-                        f"start {CONFIG.worker_startup_max_failures} times; "
-                        "last worker log tail:\n"
-                        + self._env_spawn_error.get(env_key, "<no log>")))
+        for shape in self._pending.shapes():
+            env_key = shape[2]
+            bucket = self._pending.bucket(shape)
+            while bucket:
+                rec = bucket[0]
+                if rec.cancelled:
+                    self._pending.popleft(shape)
                     continue
-                remaining.append(rec)
-                starved_envs.add(env_key)
-                self._maybe_spawn_worker(rec)
-                # a different-env task behind this one may still have an
-                # idle worker; keep scanning instead of breaking
-                continue
-            self._assign(rec, wid)
-        self._pending.extend(remaining)
+                if not self._try_acquire(rec):
+                    break                # this shape doesn't fit right now
+                if env_key in starved_envs:
+                    # spawn already requested this pass for this env;
+                    # don't rescan the idle deque per bucket
+                    self._release_charge(rec)
+                    self._maybe_spawn_worker(rec)
+                    break
+                wid = self._acquire_worker(env_key)
+                if wid is None:
+                    self._release_charge(rec)
+                    if (self._env_spawn_failures.get(env_key, 0)
+                            >= CONFIG.worker_startup_max_failures):
+                        failed_envs.add(env_key)
+                        # workers for this env die on startup repeatedly —
+                        # fail fast instead of pending forever (reference:
+                        # PopWorker status callback, ``worker_pool.h:152``)
+                        self._pending.popleft(shape)
+                        self._fail_pending_rec(
+                            rec, exceptions.RuntimeEnvSetupError(
+                                f"workers for task {rec.spec.name!r} "
+                                f"failed to start "
+                                f"{CONFIG.worker_startup_max_failures} "
+                                "times; last worker log tail:\n"
+                                + self._env_spawn_error.get(
+                                    env_key, "<no log>")))
+                        continue
+                    starved_envs.add(env_key)
+                    # parallel cold-start ramp: request a spawn per
+                    # starved task up to the startup-concurrency cap —
+                    # one spawn per dispatch pass would serialize a
+                    # burst's ramp-up behind single worker cold-starts
+                    for _ in range(min(len(bucket),
+                                       CONFIG.maximum_startup_concurrency)):
+                        self._maybe_spawn_worker(rec)
+                    # a different-env shape behind this one may still
+                    # have an idle worker; move to the next bucket
+                    break
+                self._pending.popleft(shape)
+                self._assign(rec, wid)
+            self._pending.drop_empty(shape)
         # fresh budget for future submissions: the blacklist applies to
         # tasks pending in this pass, not to the env forever
         for env in failed_envs:
@@ -2065,10 +2138,15 @@ class NodeService:
     def _local_cancel(self, task_id: TaskID, force: bool) -> None:
         rec = self._waiting_deps.pop(task_id, None)
         if rec is None:
-            for i, r in enumerate(self._pending):
+            for r in self._pending:
                 if r.spec.task_id == task_id:
                     rec = r
                     r.cancelled = True
+                    # purge immediately: a cancelled rec parked behind a
+                    # non-fitting bucket head would otherwise sit in the
+                    # queue forever, feeding phantom demand to the
+                    # autoscaler via pending_demand()
+                    self._pending.remove(r)
                     break
         if rec is not None:
             self._fail_returns(rec.spec, exceptions.TaskCancelledError(task_id))
