@@ -95,6 +95,10 @@ _CONFIG_DEFS: Dict[str, tuple] = {
     "metrics_report_interval_ms": (int, 5000, "metrics flush period"),
     # --- protocol ---
     "rpc_inline_chunk_bytes": (int, 1 << 20, "frame chunking for large messages"),
+    "object_transfer_chunk_bytes": (int, 8 << 20,
+                                    "cross-host object pulls stream in "
+                                    "chunks of this size (reference: "
+                                    "object_manager chunked Push/Pull)"),
     "grpc_equivalent_port": (int, 0, "tcp port for the head control plane (0 = unix socket)"),
     # --- lineage ---
     "max_lineage_bytes": (int, 100 * (1 << 20),

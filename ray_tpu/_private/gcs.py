@@ -182,6 +182,9 @@ class GlobalControlPlane:
         # in-flight first execution must never be duplicated)
         self._sealed_once: set = set()
         self._reconstruct_claims: Dict[ObjectID, float] = {}
+        # specs of restartable actors whose node died, awaiting a
+        # claimant (see claim_actor_reroute)
+        self._actor_reroutes: Dict[ActorID, Any] = {}
         self._restore()
 
     # ------------------------------------------------------- persistence
@@ -241,6 +244,7 @@ class GlobalControlPlane:
 
     def remove_node(self, node_id: NodeID, reason: str = "") -> None:
         dead_actors: List[ActorID] = []
+        restart_actors: List[ActorID] = []
         freed: List[Any] = []
         with self._lock:
             info = self.nodes.get(node_id)
@@ -253,7 +257,19 @@ class GlobalControlPlane:
             for oid in lost:
                 del self.directory[oid]
             for aid, rec in self.actors.items():
-                if rec.node_id == node_id and rec.state != ACTOR_DEAD:
+                if rec.node_id != node_id or rec.state == ACTOR_DEAD:
+                    continue
+                max_r = rec.spec.max_restarts
+                if max_r == -1 or rec.num_restarts < max_r:
+                    # restartable actor lost its whole node: hand the
+                    # spec to exactly one surviving claimant (reference:
+                    # GcsActorManager::OnNodeDead rescheduling)
+                    rec.num_restarts += 1
+                    rec.state = ACTOR_RESTARTING
+                    rec.node_id = None
+                    self._actor_reroutes[aid] = rec.spec
+                    restart_actors.append(aid)
+                else:
                     dead_actors.append(aid)
             # release arg pins whose submitting node can never unpin
             orphans = [tid for tid, owner in self._task_pin_owner.items()
@@ -264,9 +280,26 @@ class GlobalControlPlane:
                               "reason": reason})
         for z in freed:
             self.publish("REF_ZERO", z)
+        for aid in restart_actors:
+            self.publish("ACTOR", {"actor_id": aid,
+                                   "state": ACTOR_RESTARTING,
+                                   "reroute": True})
         for aid in dead_actors:
             self.set_actor_state(aid, ACTOR_DEAD,
                                  reason=f"node {node_id} died")
+
+    def claim_actor_reroute(self, actor_id: ActorID):
+        """Exactly-once handoff of a node-death restart: nodes race on
+        the ACTOR/reroute event; the first claim wins the spec."""
+        with self._lock:
+            return self._actor_reroutes.pop(actor_id, None)
+
+    def requeue_actor_reroute(self, actor_id: ActorID, spec) -> None:
+        """A claimant failed mid-restart: put the spec back and re-ask."""
+        with self._lock:
+            self._actor_reroutes[actor_id] = spec
+        self.publish("ACTOR", {"actor_id": actor_id,
+                               "state": ACTOR_RESTARTING, "reroute": True})
 
     def alive_nodes(self) -> List[NodeInfo]:
         with self._lock:
@@ -316,12 +349,17 @@ class GlobalControlPlane:
 
     def set_actor_state(self, actor_id: ActorID, state: str,
                         node_id: Optional[NodeID] = None,
-                        reason: str = "") -> None:
+                        reason: str = "",
+                        count_restart: bool = False) -> None:
         with self._lock:
             rec = self.actors.get(actor_id)
             if rec is None:
                 return
             rec.state = state
+            if count_restart:
+                # worker-level restarts and node-death reroutes share ONE
+                # budget: max_restarts bounds their SUM
+                rec.num_restarts += 1
             if node_id is not None:
                 rec.node_id = node_id
             if reason:

@@ -39,7 +39,8 @@ _ALLOWED = frozenset({
     "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
     "unpin_task_args", "record_lineage", "get_lineage", "claim_lineage",
     "record_cluster_event", "list_cluster_events",
-    "record_spans", "list_spans",
+    "record_spans", "list_spans", "claim_actor_reroute",
+    "requeue_actor_reroute",
 })
 
 

@@ -270,14 +270,50 @@ class _RemotePeer:
         store = self.node.store
         if store.contains(oid):
             return store.pin_and_get(oid) if pin else store.get_meta(oid)
-        res = self._chan.request(P.OBJ_PULL, lambda r: (r, oid),
-                                 timeout=self._timeout)
+        # chunked pull (reference: object_manager.h:117): the first chunk
+        # also carries the owner's meta, so small objects cost one RTT
+        # and large ones stream in bounded frames instead of one
+        # payload-sized message
+        chunk = CONFIG.object_transfer_chunk_bytes
+        res = self._chan.request(
+            P.OBJ_PULL_CHUNK, lambda r: (r, oid, 0, chunk),
+            timeout=self._timeout)
         if res is None:
             return None
         meta, data = res
         if data is None:
             return meta          # inline / error values travel in the meta
-        store.adopt_payload(oid, data)
+        if meta.size <= len(data):
+            store.adopt_payload(oid, data)
+        else:
+            writer = store.adopt_begin(oid, meta.size)
+            try:
+                writer.write(0, data)
+                # windowed stream (reference: object_manager keeps
+                # several chunks in flight): overlap RTTs instead of
+                # paying one per chunk serially
+                offsets = deque(range(len(data), meta.size, chunk))
+                window: deque = deque()
+                def issue():
+                    off = offsets.popleft()
+                    window.append((off, self._chan.request_async(
+                        P.OBJ_PULL_CHUNK,
+                        lambda r, off=off: (r, oid, off, chunk))))
+                for _ in range(min(4, len(offsets))):
+                    issue()
+                while window:
+                    off, fut = window.popleft()
+                    res = fut.result(timeout=self._timeout)
+                    if res is None or res[1] is None or not res[1]:
+                        writer.abort()   # owner lost/evicted it mid-stream
+                        return None
+                    writer.write(off, res[1])
+                    if offsets:
+                        issue()
+            except BaseException:
+                writer.abort()
+                raise
+            writer.finish()
         return store.pin_and_get(oid) if pin else store.get_meta(oid)
 
     # ----- placement groups
@@ -398,6 +434,10 @@ class NodeService:
         # set in start() when a TCP plane exists (see the probe comment)
         self.shm_probe_path: Optional[str] = None
         self.shm_probe_token: Optional[str] = None
+
+        # actor calls parked while their actor is between nodes
+        # (node-death reroute window; see _submit_actor_task)
+        self._reroute_parked: Dict[ActorID, List[P.TaskSpec]] = {}
 
         # structured lifecycle events (reference: src/ray/util/event.h)
         self.events = events.EventLogger(session_dir, self.node_id.hex(),
@@ -771,9 +811,10 @@ class NodeService:
     # the same separation the reference gets from plasma being its own
     # process.
     _DIRECT_OPS = frozenset({P.NODE_POST, P.OBJ_GET_META, P.OBJ_UNPIN,
-                             P.OBJ_PULL, P.PG_RESERVE, P.PG_RELEASE,
-                             P.NODE_STATS, P.ALLOC_OBJECT, P.PUT_OBJECT,
-                             P.PUT_OBJECT_SYNC, P.PUT_OBJECT_WIRE})
+                             P.OBJ_PULL_CHUNK, P.PG_RESERVE,
+                             P.PG_RELEASE, P.NODE_STATS, P.ALLOC_OBJECT,
+                             P.PUT_OBJECT, P.PUT_OBJECT_SYNC,
+                             P.PUT_OBJECT_WIRE})
 
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
@@ -790,8 +831,8 @@ class NodeService:
                     # request-type ops carry (req_id, ...): answer so the
                     # caller doesn't block forever / out its full timeout
                     op, payload = msg
-                    if op in (P.OBJ_GET_META, P.OBJ_PULL, P.PG_RESERVE,
-                              P.NODE_STATS,
+                    if op in (P.OBJ_GET_META, P.OBJ_PULL_CHUNK,
+                              P.PG_RESERVE, P.NODE_STATS,
                               P.ALLOC_OBJECT) and isinstance(payload, tuple):
                         result = False if op == P.PG_RESERVE else None
                         self._reply(key, P.INFO_REPLY,
@@ -814,10 +855,11 @@ class NodeService:
             self._reply(key, P.INFO_REPLY, (req_id, meta))
         elif op == P.OBJ_UNPIN:
             self.store.unpin(payload)
-        elif op == P.OBJ_PULL:
-            req_id, oid = payload
+        elif op == P.OBJ_PULL_CHUNK:
+            req_id, oid, offset, length = payload
             self._reply(key, P.INFO_REPLY,
-                        (req_id, self.store.read_payload(oid)))
+                        (req_id,
+                         self.store.read_payload_chunk(oid, offset, length)))
         elif op == P.PG_RESERVE:
             req_id, pg_key, demand = payload
             self._reply(key, P.INFO_REPLY,
@@ -941,6 +983,10 @@ class NodeService:
             self._local_ref_zero(item[1], item[2])
         elif kind == "actor_dead":
             self._on_remote_actor_dead(item[1], item[2])
+        elif kind == "actor_reroute":
+            self._reroute_actor(item[1])
+        elif kind == "actor_parked_flush":
+            self._flush_parked_actor_calls(item[1])
         elif kind == "timer":
             item[1]()
 
@@ -1947,6 +1993,13 @@ class NodeService:
             self._fail_returns(spec, exceptions.ActorDiedError(
                 spec.actor_id, rec.death_reason if rec else "unknown actor"))
             return
+        if rec.state == ACTOR_RESTARTING and rec.node_id is None:
+            # reroute window after a node death: no host exists yet.
+            # Park until placement (or death) — failing now would turn a
+            # survivable restart into a terminal ActorDiedError
+            self._reroute_parked.setdefault(
+                spec.actor_id, []).append(spec)
+            return
         owned = self._owned[spec.task_id]
         owned.assigned_node = rec.node_id
         if rec.node_id == self.node_id or rec.node_id is None:
@@ -2066,7 +2119,8 @@ class NodeService:
                 st["restarts_left"] -= 1
             st["state"] = ACTOR_RESTARTING
             self.gcs.set_actor_state(actor_id, ACTOR_RESTARTING,
-                                     node_id=self.node_id)
+                                     node_id=self.node_id,
+                                     count_restart=True)
             spec = st["spec"]
             tspec = self._creation_task_spec(spec)
             # The creation ref is single-use: keep it only if the first
@@ -2110,6 +2164,55 @@ class NodeService:
         if payload.get("state") == ACTOR_DEAD:
             self._events.put(("actor_dead", payload["actor_id"],
                               payload.get("reason", "")))
+        elif payload.get("reroute"):
+            self._events.put(("actor_reroute", payload["actor_id"]))
+        if payload["actor_id"] in self._reroute_parked:
+            # placement progressed (or death became final): re-drive the
+            # calls parked during the reroute window
+            self._events.put(("actor_parked_flush", payload["actor_id"]))
+
+    def _flush_parked_actor_calls(self, actor_id: ActorID) -> None:
+        for spec in self._reroute_parked.pop(actor_id, []):
+            # re-enters the normal path: re-parks if still unplaced,
+            # fails with the real death reason if the restart lost
+            self._submit_actor_task(spec)
+
+    def _reroute_actor(self, actor_id: ActorID) -> None:
+        """Re-create a restartable actor whose node died. All nodes see
+        the reroute event; the GCS claim admits exactly one."""
+        try:
+            orig_spec = self.gcs.claim_actor_reroute(actor_id)
+        except Exception:   # noqa: BLE001 — plane unreachable: give up
+            return
+        if orig_spec is None:
+            return
+        try:
+            import copy
+            spec = copy.copy(orig_spec)
+            rec = self.gcs.get_actor(actor_id)
+            if spec.max_restarts >= 0 and rec is not None:
+                # the new host's restart budget excludes restarts already
+                # consumed (worker deaths and node deaths both count)
+                spec.max_restarts = max(0, spec.max_restarts
+                                        - rec.num_restarts)
+            if (spec.creation_return_id
+                    and self._object_exists(spec.creation_return_id)):
+                # ready-ref already sealed by the first creation: the
+                # re-creation must not seal it again
+                spec.creation_return_id = None
+            self.events.warning(
+                "ACTOR_REROUTE", "restarting actor from a dead node",
+                actor_id=actor_id.hex())
+            self._route_actor(spec)
+        except BaseException:
+            # the claim is exactly-once: losing the spec here would
+            # strand the actor in RESTARTING forever — hand it back so
+            # another (or a later) claimant can retry
+            try:
+                self.gcs.requeue_actor_reroute(actor_id, orig_spec)
+            except Exception:   # noqa: BLE001 — plane gone too
+                pass
+            raise
 
     def _on_remote_actor_dead(self, actor_id: ActorID, reason: str) -> None:
         """Owner-side: fail owned in-flight calls to an actor that died on

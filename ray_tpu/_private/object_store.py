@@ -217,25 +217,35 @@ class ObjectStore:
             self._used += size
             return (self._arena.path, off)
 
+    def _release_unsealed_locked(self, object_id: ObjectID,
+                                 e: "_Entry") -> None:
+        """Pop an unsealed entry and free its allocation (callers hold
+        ``_lock``). The single home for the uncharge/arena-free
+        sequence, shared by dead-writer reclaim and stale-Create
+        replacement."""
+        self._entries.pop(object_id, None)
+        if e.charged:
+            self._used -= e.meta.size
+        if (e.meta.arena_ref is not None and self._arena is not None
+                and e.meta.arena_ref[0] == self._arena.path):
+            self._arena.free(e.meta.arena_ref[1])
+
     def reclaim_unsealed(self, writer_tag: int) -> None:
         """Free arena Creates whose writer connection died pre-seal."""
         with self._lock:
-            dead = [oid for oid, e in self._entries.items()
+            dead = [(oid, e) for oid, e in self._entries.items()
                     if not e.sealed and e.writer_tag == writer_tag]
-            for oid in dead:
-                e = self._entries.pop(oid)
-                if e.charged:
-                    self._used -= e.meta.size
-                if (e.meta.arena_ref is not None and self._arena is not None
-                        and e.meta.arena_ref[0] == self._arena.path):
-                    self._arena.free(e.meta.arena_ref[1])
+            for oid, e in dead:
+                self._release_unsealed_locked(oid, e)
 
-    def adopt(self, meta: ObjectMeta) -> None:
+    def adopt(self, meta: ObjectMeta) -> bool:
         """Record an object whose segment was created by another process
         (a worker sealing a large task return). This is the main write path,
         so the store budget is enforced here. For arena-backed objects this
         is the Seal half of Create/Seal: the entry exists from
-        ``alloc_in_arena`` and budget is already charged."""
+        ``alloc_in_arena`` and budget is already charged. Returns False
+        when a sealed copy already exists (the caller still owns its
+        segment and must clean it up)."""
         with self._lock:
             existing = self._entries.get(meta.object_id)
             if existing is not None:
@@ -244,27 +254,21 @@ class ObjectStore:
                     existing.sealed = True
                     existing.writer_tag = None
                     existing.last_used = time.monotonic()
-                    return
+                    return True
                 if not existing.sealed:
                     # a retried writer fell back to a different home
                     # (e.g. segment after its predecessor's orphaned
                     # Create): reclaim the stale allocation, adopt fresh
-                    self._entries.pop(meta.object_id)
-                    if existing.charged:
-                        self._used -= existing.meta.size
-                    if (existing.meta.arena_ref is not None
-                            and self._arena is not None
-                            and existing.meta.arena_ref[0]
-                            == self._arena.path):
-                        self._arena.free(existing.meta.arena_ref[1])
+                    self._release_unsealed_locked(meta.object_id, existing)
                 else:
-                    return
+                    return False
             charged = bool(meta.shm_name or meta.inline)
             if charged:
                 self._ensure_capacity(meta.size)
             self._entries[meta.object_id] = _Entry(meta=meta, sealed=True,
                                                    charged=charged)
             self._used += meta.size if charged else 0
+            return True
 
     # ------------------------------------------------------------------ get
     def contains(self, object_id: ObjectID) -> bool:
@@ -376,33 +380,93 @@ class ObjectStore:
                      ) -> Optional[Tuple[ObjectMeta, Optional[bytes]]]:
         """Raw wire bytes of an object, for cross-host pull (reference:
         ``object_manager.h:117`` Push/Pull). Inline/error values travel
-        in the meta itself (payload None). The entry is pinned during the
-        copy so a concurrent spill can't unmap it."""
+        in the meta itself (payload None)."""
+        return self.read_payload_chunk(object_id, 0, 1 << 62)
+
+    def read_payload_chunk(self, object_id: ObjectID, offset: int,
+                           length: int
+                           ) -> Optional[Tuple[ObjectMeta, Optional[bytes]]]:
+        """One bounded slice of an object's wire bytes (reference:
+        chunked Push/Pull, ``object_manager.h:117`` — multi-GB objects
+        must never become one socket frame). The entry is pinned during
+        the copy so a concurrent spill can't unmap it; inline/error
+        values ride the meta. A SPILLED object is served straight from
+        its spill file — restoring the whole object per chunk would
+        spill/restore-thrash for the length of the stream."""
         with self._lock:
-            e = self._touch(object_id)
-            if e is None:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
                 return None
+            e.last_used = time.monotonic()
+            e.ever_read = True
+            self._entries.move_to_end(object_id)
             meta = e.meta
             if meta.inline is not None or meta.error is not None:
                 return (meta, None)
-            e.pinned += 1
+            spilled = e.spilled_path
+            if spilled is None:
+                e.pinned += 1
+        if spilled is not None:
+            try:
+                with open(spilled, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(max(0, min(length, meta.size - offset)))
+                return (meta, data)
+            except OSError:
+                # Either restored (file unlinked, entry now in memory) or
+                # the spill file is genuinely gone. ONE bounded re-check
+                # through the in-memory path — unbounded retries would
+                # recurse forever on a deleted spill file.
+                with self._lock:
+                    e = self._entries.get(object_id)
+                    if (e is None or not e.sealed
+                            or e.spilled_path is not None):
+                        return None       # still spilled & unreadable
+                    e.pinned += 1
+                # fall through to the in-memory read below
         try:
+            end = min(offset + length, meta.size)
+            if offset >= meta.size:
+                return (meta, b"")
+            meta = e.meta   # may have been rewritten by a restore
             if (meta.arena_ref is not None and self._arena is not None
                     and meta.arena_ref[0] == self._arena.path):
-                data = bytes(self._arena.buffer(meta.arena_ref[1], meta.size))
+                buf = self._arena.buffer(meta.arena_ref[1], meta.size)
+                data = bytes(buf[offset:end])
             elif meta.shm_name is not None:
-                seg = (e.segment if e.segment is not None
-                       else attach_segment(meta.shm_name))
-                try:
-                    data = bytes(seg.buf[:meta.size])
-                finally:
-                    if seg is not e.segment:
-                        seg.close()
+                seg = e.segment
+                if seg is None:
+                    # cache the attachment: a streamed pull reads many
+                    # chunks, and re-mmapping the segment per chunk is
+                    # pure overhead (freed with the entry)
+                    seg = attach_segment(meta.shm_name)
+                    with self._lock:
+                        if e.segment is None:
+                            e.segment = seg
+                        elif seg is not e.segment:
+                            seg.close()
+                            seg = e.segment
+                data = bytes(seg.buf[offset:end])
             else:
                 return None
             return (meta, data)
         finally:
             self.unpin(object_id)
+
+    def adopt_begin(self, object_id: ObjectID, size: int) -> "_AdoptWriter":
+        """Incremental adoption of a pulled copy: allocate the backing
+        segment up front, stream chunks in, then finish() seals it as a
+        local secondary copy.
+
+        Deliberately a PRIVATE segment, never an arena Create: an arena
+        Create registers an unsealed entry, and a concurrent adopt() of
+        the same id (e.g. a local reconstruction finishing mid-pull)
+        treats unsealed entries as abandoned writers and frees the
+        block the streaming writer is still copying into."""
+        seg = shared_memory.SharedMemory(
+            create=True, size=max(size, 1),
+            name=f"{_segment_name(object_id)}p{os.getpid() % 100000}")
+        return _AdoptWriter(self, object_id, size, segment=seg)
 
     def adopt_payload(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
         """Store a pulled copy of a remote object as a local secondary
@@ -529,6 +593,41 @@ class ObjectStore:
             if self._arena is not None:
                 self._arena.close(unlink=True)
                 self._arena = None
+
+
+class _AdoptWriter:
+    """Streaming target for a chunked cross-host pull. Not registered
+    in the store until finish() — a half-written copy must never be
+    readable (or freeable) under its object id."""
+
+    def __init__(self, store: "ObjectStore", object_id: ObjectID, size: int,
+                 segment: shared_memory.SharedMemory):
+        self._store = store
+        self._oid = object_id
+        self._size = size
+        self._segment = segment
+
+    def write(self, offset: int, data: bytes) -> None:
+        self._segment.buf[offset:offset + len(data)] = data
+
+    def finish(self) -> ObjectMeta:
+        meta = ObjectMeta(object_id=self._oid, size=self._size,
+                          shm_name=self._segment.name)
+        if not self._store.adopt(meta):
+            # a sealed copy landed mid-stream (e.g. local reconstruction
+            # finished first): ours is redundant — unlink it or it leaks
+            existing = self._store.get_meta(self._oid)
+            self.abort()
+            return existing if existing is not None else meta
+        self._segment.close()
+        return meta
+
+    def abort(self) -> None:
+        try:
+            self._segment.close()
+            self._segment.unlink()
+        except OSError:
+            pass
 
 
 # --------------------------------------------------------------- client side

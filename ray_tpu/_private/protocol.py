@@ -56,7 +56,7 @@ ALLOC_OBJECT = 26       # (req_id, ObjectID, size) — arena Create; reply
 NODE_POST = 27          # item tuple, enqueued on the peer's event loop
 OBJ_GET_META = 28       # (req_id, ObjectID, pin) -> INFO_REPLY meta|None
 OBJ_UNPIN = 29          # ObjectID
-OBJ_PULL = 30           # (req_id, ObjectID) -> INFO_REPLY (meta, bytes)|None
+# op 30 retired: whole-payload OBJ_PULL, superseded by OBJ_PULL_CHUNK
 PG_RESERVE = 31         # (req_id, pg_key, demand) -> INFO_REPLY bool
 PG_RELEASE = 32         # pg_key
 NODE_STATS = 33         # (req_id, what) -> INFO_REPLY payload
@@ -83,6 +83,11 @@ PUT_OBJECT_WIRE = 53    # (req_id, ObjectID, bytes) — node materializes
 # (reference: NotifyDirectCallTaskBlocked/Unblocked, core_worker.cc)
 NOTIFY_BLOCKED = 54     # no payload
 NOTIFY_UNBLOCKED = 55   # no payload
+
+# Chunked cross-host pull (reference: object_manager.h:117 Push/Pull in
+# bounded chunks — a multi-GB object must never be one socket frame)
+OBJ_PULL_CHUNK = 56     # (req_id, ObjectID, offset, length)
+                        # -> INFO_REPLY (meta, bytes|None)|None
 
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)

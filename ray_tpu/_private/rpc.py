@@ -87,6 +87,12 @@ class RpcChannel:
                 timeout: Optional[float] = None) -> Any:
         """Synchronous call: sends ``(op, make_payload(req_id))``, waits
         for the correlated reply."""
+        return self.request_async(op, make_payload).result(timeout=timeout)
+
+    def request_async(self, op: int,
+                      make_payload: Callable[[int], Any]) -> Future:
+        """Send now, await later — several requests can ride the channel
+        concurrently (windowed chunk pulls overlap RTTs this way)."""
         fut: Future = Future()
         with self._lock:
             if self._closed.is_set():
@@ -95,7 +101,7 @@ class RpcChannel:
             self._next_req += 1
             self._futures[req_id] = fut
         self._conn.send((op, make_payload(req_id)))
-        return fut.result(timeout=timeout)
+        return fut
 
     def send(self, op: int, payload: Any) -> None:
         """Fire-and-forget."""

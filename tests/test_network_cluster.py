@@ -260,3 +260,92 @@ def test_chaos_under_load_actors_and_objects(tcp_cluster):
         assert val == 123
     alive = [x for x in ray_tpu.nodes() if x["alive"]]
     assert len(alive) == 2
+
+
+def test_cross_host_chunked_pull_large_object():
+    """A pull larger than the transfer chunk streams in bounded frames
+    (reference: chunked Push/Pull, ``object_manager.h:117``). Chunk size
+    is shrunk to 256KB so a ~4MB array crosses in ~16 chunks."""
+    chunk_env = {"RTPU_OBJECT_TRANSFER_CHUNK_BYTES": str(256 * 1024)}
+    cluster = Cluster(initialize_head=True, process_isolated=True,
+                      head_node_args={"num_cpus": 2, "env": chunk_env})
+    try:
+        ray_tpu.init(address=cluster)
+        cluster.add_node(num_cpus=2, resources={"far": 2.0},
+                         env={**chunk_env,
+                              "RTPU_NODE_HOST": "simulated-other-host"})
+        _wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"far": 1.0})
+        def produce():
+            return np.arange(500_000, dtype=np.float64)   # ~4MB
+
+        @ray_tpu.remote
+        def consume(x):
+            return float(x.sum()), x.shape[0]
+
+        total, n = ray_tpu.get(consume.remote(produce.remote()),
+                               timeout=120)
+        assert n == 500_000
+        assert total == pytest.approx(
+            float(np.arange(500_000, dtype=np.float64).sum()))
+
+        # reverse direction too: head-owned 4MB arg into a far task
+        big = np.random.rand(500_000)
+        ref = ray_tpu.put(big)
+
+        @ray_tpu.remote(resources={"far": 1.0})
+        def consume_far(x):
+            return float(x.sum())
+
+        assert ray_tpu.get(consume_far.remote(ref), timeout=120) == \
+            pytest.approx(float(big.sum()))
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_actor_restarts_on_surviving_node_after_node_death(tcp_cluster):
+    """A restartable actor whose NODE is SIGKILLed is re-created on a
+    surviving node (reference: GcsActorManager::OnNodeDead actor
+    rescheduling) — deterministic placement via soft node affinity."""
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    victim = tcp_cluster.add_node(num_cpus=2)
+    _wait_for_nodes(2)
+    victim_id = NodeID.from_hex(victim.node_id_hex)
+
+    @ray_tpu.remote(max_restarts=2, num_cpus=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            import ray_tpu as rt
+            return rt.get_runtime_context().node_id.hex()
+
+    p = Phoenix.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=victim_id, soft=True)).remote()
+    assert ray_tpu.get(p.bump.remote(), timeout=60) == 1
+    assert ray_tpu.get(p.where.remote(), timeout=60) == victim.node_id_hex
+
+    tcp_cluster.remove_node(victim)          # SIGKILL the actor's node
+
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            out = ray_tpu.get(p.bump.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        pytest.fail("actor never restarted after its node was killed")
+    assert out >= 1                          # fresh state, restarted
+    new_home = ray_tpu.get(p.where.remote(), timeout=30)
+    assert new_home != victim.node_id_hex
