@@ -15,7 +15,9 @@ class JsonHandler(BaseHTTPRequestHandler):
         pass
 
     def _json(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode()
+        # default=str: handler results may carry numpy scalars/bytes —
+        # stringify rather than turning a good reply into a 500
+        body = json.dumps(payload, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

@@ -18,6 +18,7 @@ from .api import (  # noqa: F401
     run,
     shutdown,
     start_http,
+    stop_http,
 )
 from .batching import batch  # noqa: F401
 from .handle import DeploymentHandle  # noqa: F401

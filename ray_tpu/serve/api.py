@@ -10,7 +10,7 @@ identical for HTTP and Python callers.
 from __future__ import annotations
 
 import json
-import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 from .. import get, get_actor, kill
@@ -108,10 +108,7 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _http_server
-    if _http_server is not None:
-        _http_server.shutdown()
-        _http_server = None
+    stop_http()
     try:
         controller = get_actor(_CONTROLLER_NAME)
     except ValueError:
@@ -125,41 +122,91 @@ def shutdown() -> None:
 
 # ------------------------------------------------------------- HTTP gateway
 
+class _GatewayHandler:
+    """Shared dispatch for the JSON gateway (reference: HTTPProxy,
+    ``_private/proxy.py:912``): ``POST /{deployment}`` calls the
+    deployment with the parsed JSON body, ``GET /{deployment}`` calls it
+    with the query params (or None), ``GET /-/routes`` lists routes.
+    Unknown deployments are 404, deployment exceptions 500."""
+
+    _ROUTES_TTL_S = 2.0
+
+    def __init__(self):
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes_cache: Dict[str, str] = {}
+        self._routes_at = 0.0
+
+    def routes(self) -> Dict[str, str]:
+        # TTL-cached: the 404 check must not put a controller RPC on
+        # every data-path request
+        now = time.monotonic()
+        if now - self._routes_at > self._ROUTES_TTL_S:
+            ctrl = _get_or_create_controller()
+            self._routes_cache = {
+                f"/{name}": name
+                for name in get(ctrl.list_deployments.remote())}
+            self._routes_at = now
+        return self._routes_cache
+
+    def call(self, name: str, arg):
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = get_deployment_handle(name)
+            self._handles[name] = handle
+        return handle.remote(arg).result(timeout=30.0)
+
+
 def start_http(host: str = "127.0.0.1", port: int = 8000) -> str:
-    """Minimal JSON gateway: POST /{deployment} with a JSON body calls
-    the deployment with the parsed body (reference: HTTPProxy
-    ``_private/proxy.py:912``)."""
     global _http_server
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from .._private.http_util import HttpServerBase, JsonHandler
 
-    handles: Dict[str, DeploymentHandle] = {}
+    # restarting replaces the gateway: the old thread/port must not be
+    # orphaned (they'd hold the bind until process exit)
+    stop_http()
+    gateway = _GatewayHandler()
 
-    class Handler(BaseHTTPRequestHandler):
-        def do_POST(self):
-            name = self.path.strip("/").split("/")[0]
+    class Handler(JsonHandler):
+        def _dispatch(self, arg_from_body: bool):
+            path, _, query = self.path.partition("?")
+            name = path.strip("/").split("/")[0]
             try:
-                handle = handles.get(name)
-                if handle is None:
-                    handle = get_deployment_handle(name)
-                    handles[name] = handle
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"null")
-                result = handle.remote(body).result(timeout=30.0)
-                payload = json.dumps({"result": result},
-                                     default=str).encode()
-                self.send_response(200)
-            except Exception as e:
-                payload = json.dumps({"error": str(e)}).encode()
-                self.send_response(500)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
+                if path.rstrip("/") == "/-/routes":
+                    return self._json(200, gateway.routes())
+                if not name or f"/{name}" not in gateway.routes():
+                    return self._json(404,
+                                      {"error": f"no deployment {name!r}"})
+                if arg_from_body:
+                    # an EMPTY body means "no argument" (None), matching
+                    # the GET-without-query semantics below
+                    n = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(n)
+                    arg = json.loads(raw) if raw else None
+                else:
+                    from urllib.parse import parse_qs
+                    q = {k: v[0] if len(v) == 1 else v
+                         for k, v in parse_qs(query).items()}
+                    arg = q or None
+                result = gateway.call(name, arg)
+                return self._json(200, {"result": result})
+            except Exception as e:   # noqa: BLE001 — always answer JSON
+                return self._json(500, {"error": str(e)})
 
-        def log_message(self, *args):
-            pass
+        def do_POST(self):
+            self._dispatch(arg_from_body=True)
 
-    _http_server = ThreadingHTTPServer((host, port), Handler)
-    threading.Thread(target=_http_server.serve_forever,
-                     daemon=True).start()
-    return f"http://{host}:{_http_server.server_address[1]}"
+        def do_GET(self):
+            self._dispatch(arg_from_body=False)
+
+    class Gateway(HttpServerBase):
+        thread_name = "rtpu-serve-http"
+
+    _http_server = Gateway(Handler, host=host, port=port)
+    _http_server.start()
+    return f"http://{host}:{_http_server.port}"
+
+
+def stop_http() -> None:
+    global _http_server
+    if _http_server is not None:
+        _http_server.stop()
+        _http_server = None

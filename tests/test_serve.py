@@ -148,3 +148,78 @@ def test_batch_deadline_is_absolute():
         th.join(timeout=5)
     assert first_latency["dt"] < 0.8, (
         f"first caller waited {first_latency['dt']:.2f}s (deadline reset)")
+
+
+def test_http_gateway_routes_and_errors(serve_session):
+    @serve.deployment
+    def greet(body):
+        if body and body.get("boom"):
+            raise ValueError("deployment exploded")
+        return {"hello": (body or {}).get("who", "world")}
+
+    serve.run(greet.bind())
+    url = serve.start_http(port=0)
+
+    # route listing
+    with urllib.request.urlopen(f"{url}/-/routes", timeout=10) as resp:
+        assert json.loads(resp.read()) == {"/greet": "greet"}
+
+    # GET with query params
+    with urllib.request.urlopen(f"{url}/greet?who=tpu", timeout=10) as resp:
+        assert json.loads(resp.read())["result"] == {"hello": "tpu"}
+
+    # unknown deployment -> 404 (not a generic 500)
+    try:
+        urllib.request.urlopen(f"{url}/nope", timeout=10)
+        assert False, "expected HTTPError"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    # deployment exception -> 500 with the error message
+    req = urllib.request.Request(
+        f"{url}/greet", data=json.dumps({"boom": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "expected HTTPError"
+    except urllib.error.HTTPError as e:
+        assert e.code == 500
+        assert "exploded" in json.loads(e.read())["error"]
+
+
+def test_http_gateway_concurrent_posts(serve_session):
+    import concurrent.futures
+
+    @serve.deployment(num_replicas=2)
+    def double(body):
+        return body["x"] * 2
+
+    serve.run(double.bind())
+    url = serve.start_http(port=0)
+
+    def post(i):
+        req = urllib.request.Request(
+            f"{url}/double", data=json.dumps({"x": i}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())["result"]
+
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        out = list(pool.map(post, range(16)))
+    assert out == [i * 2 for i in range(16)]
+
+
+def test_stop_http_releases_port(serve_session):
+    @serve.deployment
+    def one(body):
+        return 1
+
+    serve.run(one.bind())
+    url = serve.start_http(port=0)
+    port = int(url.rsplit(":", 1)[1])
+    serve.stop_http()
+    # the port is free for an immediate rebind (server_close ran)
+    import socket as s
+    sock = s.socket()
+    sock.bind(("127.0.0.1", port))
+    sock.close()
