@@ -617,6 +617,10 @@ class GlobalControlPlane:
         with self._lock:
             return list(self.actors.items())
 
+    def jobs_snapshot(self) -> List[JobRecord]:
+        with self._lock:
+            return list(self.jobs.values())
+
     def directory_snapshot(self) -> List[Tuple[ObjectID,
                                                Tuple[NodeID, ObjectMeta]]]:
         with self._lock:

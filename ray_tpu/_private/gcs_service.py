@@ -35,7 +35,7 @@ _ALLOWED = frozenset({
     "kv_put", "kv_get", "kv_del", "kv_keys", "publish_location",
     "lookup_location", "drop_location", "register_pg", "get_pg",
     "remove_pg", "record_task_event", "list_task_events", "publish",
-    "actors_snapshot", "directory_snapshot", "pgs_snapshot",
+    "actors_snapshot", "directory_snapshot", "pgs_snapshot", "jobs_snapshot",
     "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
     "unpin_task_args", "record_lineage", "get_lineage", "claim_lineage",
     "record_cluster_event", "list_cluster_events",

@@ -2602,6 +2602,11 @@ class NodeService:
                      "bundles": rec["spec"].bundles,
                      "strategy": rec["spec"].strategy}
                     for pid, rec in self.gcs.pgs_snapshot()]
+        if what == "jobs":
+            return [{"job_id": rec.job_id, "driver_pid": rec.driver_pid,
+                     "start_time": rec.start_time,
+                     "end_time": rec.end_time}
+                    for rec in self.gcs.jobs_snapshot()]
         if what == "cluster_events":
             # full ring: the state API applies filters BEFORE its limit,
             # so a server-side cap would hide older matching rows

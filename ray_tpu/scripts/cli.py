@@ -80,7 +80,7 @@ def cmd_status(client, args) -> None:
 
 
 def cmd_list(client, args) -> None:
-    from ..state import (list_actors, list_nodes, list_objects,
+    from ..state import (list_actors, list_jobs, list_nodes, list_objects,
                          list_placement_groups, list_tasks, list_workers)
     what = args.what
     if what == "tasks":
@@ -103,6 +103,9 @@ def cmd_list(client, args) -> None:
     elif what == "workers":
         rows = list_workers()
         cols = ["worker_id", "pid", "state", "actor_id"]
+    elif what == "jobs":
+        rows = list_jobs()
+        cols = ["job_id", "driver_pid", "start_time", "end_time"]
     else:
         raise SystemExit(f"unknown list target {what!r}")
     if args.format == "json":

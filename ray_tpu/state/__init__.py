@@ -8,6 +8,7 @@ records straight from the control plane.
 
 from .api import (  # noqa: F401
     list_actors,
+    list_jobs,
     list_nodes,
     list_objects,
     list_placement_groups,

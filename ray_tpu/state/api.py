@@ -113,6 +113,13 @@ def list_workers(filters: Optional[dict] = None) -> List[dict]:
         _ctx.require_client().cluster_info("workers") or [], filters)
 
 
+def list_jobs(filters: Optional[dict] = None) -> List[dict]:
+    """Driver jobs with start/end times (reference: ``ray list jobs``)."""
+    rows = [{**rec, "job_id": _hex(rec["job_id"])}
+            for rec in _query("jobs") or []]
+    return _apply_filters(rows, filters)
+
+
 def summarize_task_rows(rows: List[dict]) -> Dict[str, Any]:
     by_state = Counter(r["state"] for r in rows)
     by_func: Dict[str, Counter] = defaultdict(Counter)

@@ -119,3 +119,12 @@ def test_cli_subprocess(rtpu_init):
         [sys.executable, "-m", "ray_tpu.scripts.cli", "--session",
          session, "status"], capture_output=True, text=True, timeout=60)
     assert "Nodes: 1 alive" in status.stdout
+
+
+def test_list_jobs(rtpu_init):
+    from ray_tpu.state import api as state_api
+
+    jobs = state_api.list_jobs()
+    assert len(jobs) == 1                    # this driver's job
+    assert jobs[0]["driver_pid"] > 0
+    assert jobs[0]["end_time"] is None       # still running
