@@ -12,6 +12,7 @@ current_client: Optional[Any] = None
 # Set inside a worker process while executing a task.
 current_task_id = None
 current_actor_id = None
+current_accel_ids = None        # TPU slot indices assigned at dispatch
 in_worker: bool = False
 
 # Per-task namespace: a ContextVar so concurrent method calls of a

@@ -97,6 +97,8 @@ class _TaskRecord:
     # when a task's user threads block concurrently — the first
     # unblock would re-charge while others still wait)
     blocked_depth: int = 0
+    # exclusive TPU slot indices held while running (whole-chip demands)
+    accel_ids: Optional[List[int]] = None
 
 
 class _PendingQueue:
@@ -430,6 +432,12 @@ class NodeService:
 
         self._memory_monitor = memory_monitor.MemoryMonitor()
         self._last_mem_check = 0.0
+
+        # per-instance TPU slots (reference: resource-instance ids):
+        # whole-chip demands get exclusive indices; fractional shares
+        # are capacity-only
+        self._tpu_free: deque = deque(
+            range(int(self.resources_total.get("TPU", 0))))
 
         # set in start() when a TCP plane exists (see the probe comment)
         self.shm_probe_path: Optional[str] = None
@@ -1473,6 +1481,10 @@ class NodeService:
                 if not sched.fits(self.resources_available, demand):
                     return False
                 sched.subtract(self.resources_available, demand)
+            n_tpu = int(demand.get("TPU", 0))
+            if n_tpu >= 1 and len(self._tpu_free) >= n_tpu:
+                rec.accel_ids = [self._tpu_free.popleft()
+                                 for _ in range(n_tpu)]
         rec.charge = dict(demand)
         return True
 
@@ -1489,7 +1501,15 @@ class NodeService:
             pool = self._rec_charge_pool(rec)
             if pool is not None:
                 sched.add(pool, charge)
+            rec.accel_ids = self._return_tpu_slots(rec.accel_ids)
         rec.charge = None
+
+    def _return_tpu_slots(self, ids) -> None:
+        """Return exclusive slot ids to the pool (callers hold
+        ``_res_lock``); returns None for assign-back convenience."""
+        if ids:
+            self._tpu_free.extend(ids)
+        return None
 
     def _rec_charge_pool(self, rec: _TaskRecord):
         if rec.pg_key is not None:
@@ -1797,6 +1817,7 @@ class NodeService:
         self._running[rec.spec.task_id] = rec
         self._record_event(rec.spec, "RUNNING")
         self._pin_deps(rec)
+        rec.spec.accel_ids = rec.accel_ids
         try:
             w.conn.send((P.EXECUTE_TASK, (rec.kind, rec.spec, rec.deps,
                                           rec.actor_spec)))
@@ -1971,12 +1992,15 @@ class NodeService:
                 w.actor_id = None
                 self._mark_idle(w)
             return
-        # actor keeps its resource charge for its lifetime
+        # actor keeps its resource charge (and TPU slots) for its lifetime
         if st is not None:
             st["state"] = ACTOR_ALIVE
             st["worker_id"] = rec.worker_id
             st["charge"] = rec.charge
             st["pg_key"] = rec.pg_key
+            st["accel_ids"] = rec.accel_ids
+            rec.accel_ids = None    # ownership moved: rec release must
+            rec.charge = None       # not double-return them
         w = self._workers.get(rec.worker_id)
         if w is not None:
             w.task = None
@@ -2066,6 +2090,7 @@ class NodeService:
         self._running[rec.spec.task_id] = rec
         self._record_event(rec.spec, "RUNNING")
         self._pin_deps(rec)
+        rec.spec.accel_ids = st.get("accel_ids")
         try:
             w.conn.send((P.EXECUTE_TASK, ("actor_call", rec.spec, rec.deps,
                                           None)))
@@ -2159,6 +2184,7 @@ class NodeService:
                     sched.add(pool, charge)
             else:
                 sched.add(self.resources_available, charge)
+            st["accel_ids"] = self._return_tpu_slots(st.get("accel_ids"))
 
     def _on_actor_event(self, payload) -> None:
         if payload.get("state") == ACTOR_DEAD:

@@ -141,6 +141,10 @@ class TaskSpec:
     # tracing: caller's (trace_id, span_id), propagated into the worker
     # (reference: ray.util.tracing traceparent in the task spec)
     trace_context: Optional[dict] = None
+    # per-instance accelerator slots assigned by the executing node at
+    # dispatch (reference: resource-instance ids / GPU id assignment);
+    # read via get_runtime_context().get_accelerator_ids()
+    accel_ids: Optional[List[int]] = None
 
 
 @dataclass

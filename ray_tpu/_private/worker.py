@@ -108,6 +108,7 @@ class WorkerRuntime:
     def _run_one(self, kind: str, spec: P.TaskSpec, deps,
                  actor_spec: Optional[P.ActorSpec]) -> None:
         context.current_task_id = spec.task_id
+        context.current_accel_ids = spec.accel_ids
         # inherit the submitting job's namespace so nested named-actor
         # lookups/creations resolve where the driver's would (ContextVar:
         # concurrent calls on a threaded actor don't race each other)
@@ -135,6 +136,7 @@ class WorkerRuntime:
             self._send_done(spec, kind, None, e)
         finally:
             context.current_task_id = None
+            context.current_accel_ids = None   # slot may be recycled next
             # don't leak this task's trace into spans a later codepath
             # might open on the same pool thread
             from ..util import tracing
@@ -159,6 +161,9 @@ class WorkerRuntime:
 
     async def _run_async(self, spec: P.TaskSpec, deps) -> None:
         context.current_namespace.set(spec.namespace)
+        # actor-wide slots: identical for every call of this actor, so
+        # the module-global is safe under asyncio interleaving
+        context.current_accel_ids = spec.accel_ids
         # stackless span: concurrent async calls interleave on one loop
         # thread, so the thread-local span stack would mis-nest them
         from ..util import tracing

@@ -35,6 +35,14 @@ def _build_resources(opts: Dict[str, Any], default_cpus: float) -> Dict[str, flo
         res["memory"] = float(opts["memory"])
     for k, v in (opts.get("resources") or {}).items():
         res[k] = float(v)
+    for k, v in res.items():
+        if v > 1 and not float(v).is_integer():
+            # fractional shares are only meaningful within one unit —
+            # 1.5 TPUs cannot map to exclusive chip slots (reference:
+            # fractional quantities must be <= 1)
+            raise ValueError(
+                f"resource quantities over 1 must be whole numbers, "
+                f"got {k}={v}")
     return {k: v for k, v in res.items() if v}
 
 

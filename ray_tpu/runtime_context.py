@@ -17,6 +17,13 @@ class RuntimeContext:
     task_id: Optional[object]
     actor_id: Optional[object]
     in_worker: bool
+    accel_ids: Optional[list] = None
+
+    def get_accelerator_ids(self) -> dict:
+        """Per-instance accelerator slots assigned to this task/actor
+        (reference: ``RuntimeContext.get_accelerator_ids`` — GPU ids);
+        empty on the driver or for fractional/zero demands."""
+        return {"TPU": list(self.accel_ids or [])}
 
     def get_job_id(self):
         return self.job_id
@@ -43,4 +50,5 @@ def get_runtime_context() -> RuntimeContext:
         task_id=context.current_task_id,
         actor_id=context.current_actor_id,
         in_worker=context.in_worker,
+        accel_ids=context.current_accel_ids,
     )

@@ -275,3 +275,54 @@ def test_nested_tasks_saturating_cpus_no_deadlock():
                            timeout=60) == 3
     finally:
         ray_tpu.shutdown()
+
+
+def test_accelerator_slot_assignment():
+    """Whole-chip TPU demands get exclusive per-instance slot ids
+    (reference: resource-instance ids / GPU id assignment)."""
+    import time as _time
+
+    ray_tpu.init(num_cpus=4, num_tpus=2)
+    try:
+        @ray_tpu.remote(num_tpus=1)
+        def which_chip():
+            import ray_tpu as rt
+            _time.sleep(0.5)          # force concurrent occupancy
+            return rt.get_runtime_context().get_accelerator_ids()["TPU"]
+
+        a, b = ray_tpu.get([which_chip.remote(), which_chip.remote()],
+                           timeout=60)
+        assert sorted(a + b) == [0, 1]    # disjoint exclusive slots
+
+        # slots recycle once released
+        c = ray_tpu.get(which_chip.remote(), timeout=60)
+        assert c in ([0], [1])
+
+        # a two-chip task owns both
+        @ray_tpu.remote(num_tpus=2)
+        def both():
+            import ray_tpu as rt
+            return rt.get_runtime_context().get_accelerator_ids()["TPU"]
+
+        assert sorted(ray_tpu.get(both.remote(), timeout=60)) == [0, 1]
+
+        # actors hold their slots for their lifetime
+        @ray_tpu.remote(num_tpus=1)
+        class Chip:
+            def ids(self):
+                import ray_tpu as rt
+                return rt.get_runtime_context().get_accelerator_ids()["TPU"]
+
+        holder = Chip.remote()
+        held = ray_tpu.get(holder.ids.remote(), timeout=60)
+        assert held in ([0], [1])
+        # with one chip held, a 2-chip task has no feasible slots but a
+        # 1-chip task gets the other id
+        other = ray_tpu.get(which_chip.remote(), timeout=60)
+        assert other != held and other in ([0], [1])
+
+        # driver context: no slots
+        assert ray_tpu.get_runtime_context().get_accelerator_ids() == \
+            {"TPU": []}
+    finally:
+        ray_tpu.shutdown()
