@@ -62,6 +62,10 @@ class FileStorage:
         with self._lock:
             self._f.write(_LEN.pack(len(data)) + data)
             self._f.flush()
+            # fsync so an acknowledged durable mutation survives host
+            # power loss, matching compact()'s guarantee. Appends are
+            # rare (jobs/durable-KV/PGs only), so per-append cost is fine.
+            os.fsync(self._f.fileno())
 
     def load(self) -> List[Entry]:
         out: List[Entry] = []

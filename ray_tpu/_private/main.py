@@ -46,6 +46,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dashboard-port", type=int, default=0,
                     help="head only: dashboard HTTP port (0 = auto, "
                          "-1 = disabled)")
+    ap.add_argument("--http-host", default="127.0.0.1",
+                    help="bind address for the dashboard + job REST "
+                         "servers (default loopback; set 0.0.0.0 to "
+                         "expose on all interfaces)")
     ap.add_argument("--storage", default=None,
                     help="head only: GCS persistence path (journal file "
                          "or directory); durable KV/jobs/PG metadata "
@@ -97,28 +101,34 @@ def main(argv=None) -> int:
                                "host": node.host,
                                "shm_probe": [node.shm_probe_path,
                                              node.shm_probe_token]}).encode())
+        # advertise an address something actually listens on: a loopback
+        # bind must not be advertised as the external advertise_host
+        http_adv = (args.advertise_host if args.http_host == "0.0.0.0"
+                    else args.http_host)
         # job submission API (reference: dashboard job head)
         from ..job.http_server import JobRestServer
         from ..job.manager import JobManager
         manager = JobManager(
             gcs, cluster_address=f"{args.advertise_host}:{gcs_port}",
             session_dir=session_dir)
-        job_rest = JobRestServer(manager, port=args.job_port)
+        job_rest = JobRestServer(manager, host=args.http_host,
+                                 port=args.job_port)
         job_rest.start()
         job_port = job_rest.port
         gcs.kv_put(b"__rtpu_job_api",
-                   f"{args.advertise_host}:{job_port}".encode())
+                   f"{http_adv}:{job_port}".encode())
 
     dashboard = None
     dashboard_port = None
     if args.head and args.dashboard_port >= 0:
         from ..dashboard import DashboardServer
         dashboard = DashboardServer(node, job_manager=manager,
+                                    host=args.http_host,
                                     port=args.dashboard_port)
         dashboard.start()
         dashboard_port = dashboard.port
         gcs.kv_put(b"__rtpu_dashboard",
-                   f"{args.advertise_host}:{dashboard_port}".encode())
+                   f"{http_adv}:{dashboard_port}".encode())
 
     ready = {"node_id": node.node_id.hex(), "gcs_port": gcs_port,
              "node_address": node.tcp_address, "session_dir": session_dir,

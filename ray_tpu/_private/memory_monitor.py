@@ -135,7 +135,10 @@ def pick_oom_victim(workers: Iterable,
             retriable = 2 if (rec.retries_left > 0
                               or getattr(rec, "oom_retries_left", 0) > 0
                               ) else 0
-        key = (retriable, w.started_at)        # higher rank; newest wins
+        # newest *assignment* wins (pooled workers are reused, so process
+        # start time would misrank sunk cost); fall back to process start
+        # for workers that predate assignment stamping
+        key = (retriable, getattr(w, "assigned_at", 0.0) or w.started_at)
         if best_key is None or key > best_key:
             best, best_key = w, key
     return best

@@ -53,6 +53,10 @@ class _Worker:
     task: Optional["_TaskRecord"] = None
     actor_id: Optional[ActorID] = None
     started_at: float = field(default_factory=time.monotonic)
+    # when the current task/actor work was assigned — pooled workers are
+    # reused, so the OOM RetriableLIFO must rank by work recency, not
+    # process age
+    assigned_at: float = 0.0
     # runtime-env pool key (reference: WorkerPool keyed by runtime env,
     # ``worker_pool.h:152``); "" = the default environment
     env_key: str = ""
@@ -1808,6 +1812,7 @@ class NodeService:
         w = self._workers[wid]
         w.state = "ACTOR" if rec.kind == "actor_create" else "BUSY"
         w.task = rec
+        w.assigned_at = time.monotonic()
         rec.worker_id = wid
         if rec.kind == "actor_create":
             w.actor_id = rec.actor_spec.actor_id

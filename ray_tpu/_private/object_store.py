@@ -18,6 +18,7 @@ LRU eviction + spilling). Design differences, on purpose:
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
@@ -30,6 +31,17 @@ from .config import CONFIG
 from .ids import ObjectID
 
 _SHM_PREFIX = "rtpu"
+
+# Secondary-copy (adopted) segments get a per-call unique suffix: two
+# concurrent pulls of the same object in one process must not collide on
+# a deterministic name (the second create would raise FileExistsError and
+# fail the pull instead of deduping).
+_adopt_seq = itertools.count()
+
+
+def _adopt_segment_name(object_id: ObjectID) -> str:
+    return (f"{_segment_name(object_id)}p{os.getpid() % 100000}"
+            f"c{next(_adopt_seq)}")
 
 
 def _segment_name(object_id: ObjectID) -> str:
@@ -465,7 +477,7 @@ class ObjectStore:
         block the streaming writer is still copying into."""
         seg = shared_memory.SharedMemory(
             create=True, size=max(size, 1),
-            name=f"{_segment_name(object_id)}p{os.getpid() % 100000}")
+            name=_adopt_segment_name(object_id))
         return _AdoptWriter(self, object_id, size, segment=seg)
 
     def adopt_payload(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
@@ -487,12 +499,33 @@ class ObjectStore:
             # "cross-host" is simulated on one machine (RTPU_NODE_HOST)
             seg = shared_memory.SharedMemory(
                 create=True, size=max(size, 1),
-                name=f"{_segment_name(object_id)}p{os.getpid() % 100000}")
+                name=_adopt_segment_name(object_id))
             seg.buf[:size] = data
             name = seg.name
             seg.close()
             meta = ObjectMeta(object_id=object_id, size=size, shm_name=name)
-        self.adopt(meta)
+        if not self.adopt(meta):
+            # A concurrent pull sealed a copy first: ours is redundant
+            # and must not leak (unique names mean this race no longer
+            # errors out). Arena case: our unsealed Create was already
+            # reclaimed by the winner's adopt (_release_unsealed_locked),
+            # so freeing again here would double-free — only the private
+            # shm segment is still ours to unlink.
+            if meta.arena_ref is None:
+                try:
+                    s = shared_memory.SharedMemory(name=meta.shm_name)
+                    s.close()
+                    s.unlink()
+                except OSError:
+                    pass
+            with self._lock:
+                e = self._entries.get(object_id)
+                if e is not None and e.sealed:
+                    return e.meta
+            # winner evicted between adopt() and the re-lookup: our copy
+            # is gone too (unlinked/reclaimed above) — redo the adoption
+            # from the payload we still hold
+            return self.adopt_payload(object_id, data)
         return meta
 
     def stats(self) -> Dict[str, int]:

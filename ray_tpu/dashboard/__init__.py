@@ -137,7 +137,9 @@ class DashboardServer(HttpServerBase):
 
     thread_name = "rtpu-dashboard"
 
-    def __init__(self, node, job_manager=None, host: str = "0.0.0.0",
+    # loopback by default: full cluster state should not be readable by
+    # any network peer without an explicit opt-in (--http-host=0.0.0.0)
+    def __init__(self, node, job_manager=None, host: str = "127.0.0.1",
                  port: int = 0):
         super().__init__(_Handler, host=host, port=port,
                          node=node, job_manager=job_manager)

@@ -64,6 +64,9 @@ class _Handler(JsonHandler):
 class JobRestServer(HttpServerBase):
     thread_name = "rtpu-job-rest"
 
-    def __init__(self, manager: JobManager, host: str = "0.0.0.0",
+    # loopback by default: the REST API exposes job submission (arbitrary
+    # code execution) — binding all interfaces requires an explicit opt-in
+    # (reference dashboard defaults to 127.0.0.1 for the same reason)
+    def __init__(self, manager: JobManager, host: str = "127.0.0.1",
                  port: int = 0):
         super().__init__(_Handler, host=host, port=port, manager=manager)

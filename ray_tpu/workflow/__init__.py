@@ -77,7 +77,15 @@ class _WorkflowStorage:
                 stored = json.load(f)
         except (OSError, ValueError):
             return
-        if stored != _plan_fingerprint(dag, args, kwargs):
+        current = _plan_fingerprint(dag, args, kwargs)
+        if stored.get("hash_v") != current.get("hash_v"):
+            # encoding changed between releases: only the structural
+            # fields are comparable
+            stored = {k: v for k, v in stored.items()
+                      if k in ("steps", "edges")}
+            current = {k: v for k, v in current.items()
+                       if k in ("steps", "edges")}
+        if stored != current:
             raise ValueError(
                 "workflow id already exists with a DIFFERENT dag or "
                 "inputs; reusing its checkpoints would return results "
@@ -167,11 +175,74 @@ def _plan_fingerprint(dag: DAGNode, args: tuple, kwargs: dict) -> dict:
     # JSON-native shapes only (the stored copy round-trips through json)
     edges = sorted([index[id(c)], index[id(n)]]
                    for n in nodes for c in n._children())
-    consts = [[repr(a) for a in n._bound_args if not isinstance(a, DAGNode)]
+    # Hash a canonical value encoding, not repr(): reprs truncate large
+    # arrays (different inputs would collide) and embed object addresses
+    # (identical re-runs would spuriously differ). Raw pickle bytes are
+    # also not enough — set iteration order varies across interpreter
+    # hash seeds — so containers are canonicalized first.
+    consts = [([a for a in n._bound_args if not isinstance(a, DAGNode)],
+               {k: v for k, v in sorted(n._bound_kwargs.items())
+                if not isinstance(v, DAGNode)})
               for n in nodes]
-    blob = repr((consts, repr(args), sorted(kwargs.items()))).encode()
+    h = hashlib.sha256()
+    _stable_update(h, (consts, args, kwargs))
+    # "hash_v" versions the encoding: plans checkpointed under an older
+    # scheme skip the args comparison instead of spuriously rejecting an
+    # identical re-run (structure — steps/edges — is still compared)
     return {"steps": sorted(ids.values()), "edges": edges,
-            "args_hash": hashlib.sha256(blob).hexdigest()}
+            "args_hash": h.hexdigest(), "hash_v": 2}
+
+
+def _stable_update(h, obj) -> None:
+    """Feed ``obj`` into hash ``h`` as a canonical, process-stable byte
+    encoding. Containers are walked with type tags; unordered containers
+    are sorted by their members' canonical digests (set pickle bytes
+    depend on the interpreter hash seed); arrays hash their raw buffer;
+    anything else falls back to its pickled bytes."""
+    import hashlib
+    import numpy as _np
+
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        h.update(f"{type(obj).__name__}:{obj!r};".encode())
+    elif isinstance(obj, (list, tuple)):
+        h.update(f"{type(obj).__name__}[{len(obj)}](".encode())
+        for item in obj:
+            _stable_update(h, item)
+        h.update(b")")
+    elif isinstance(obj, dict):
+        h.update(f"dict[{len(obj)}](".encode())
+        for key, sub in sorted(obj.items(),
+                               key=lambda kv: _stable_digest(kv[0])):
+            _stable_update(h, key)
+            _stable_update(h, sub)
+        h.update(b")")
+    elif isinstance(obj, (set, frozenset)):
+        h.update(f"{type(obj).__name__}[{len(obj)}](".encode())
+        for d in sorted(_stable_digest(item) for item in obj):
+            h.update(d)
+        h.update(b")")
+    elif isinstance(obj, _np.ndarray):
+        if obj.dtype == object:
+            # object arrays' raw buffer is PyObject pointers — hash the
+            # elements by value instead
+            h.update(f"ndarray:object:{obj.shape}(".encode())
+            for item in obj.ravel():
+                _stable_update(h, item)
+            h.update(b")")
+        else:
+            arr = _np.ascontiguousarray(obj)
+            h.update(f"ndarray:{arr.dtype}:{arr.shape};".encode())
+            h.update(arr.tobytes())
+    else:
+        h.update(b"pickle:")
+        h.update(ser.dumps_function(obj))
+
+
+def _stable_digest(obj) -> bytes:
+    import hashlib
+    h = hashlib.sha256()
+    _stable_update(h, obj)
+    return h.digest()
 
 
 def _execute_durable(wf: _WorkflowStorage, dag: DAGNode, args: tuple,
