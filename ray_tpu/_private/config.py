@@ -36,6 +36,16 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                    "spread above (reference: scheduler_spread_threshold)"),
     "scheduler_top_k_fraction": (float, 0.2,
                                  "hybrid policy: random choice among best k nodes"),
+    "scheduler_route_debit_ttl_s": (float, 2.0,
+                                    "how long a routed-but-unconfirmed task's "
+                                    "resources stay debited from the router's "
+                                    "view of the target node (bridges heartbeat "
+                                    "staleness so bursts don't pile onto one node)"),
+    "scheduler_spillback_delay_s": (float, 0.25,
+                                    "re-route a queued task to another node with "
+                                    "free capacity after it has starved locally "
+                                    "this long (reference: lease spillback, "
+                                    "cluster_task_manager.cc)"),
     "worker_lease_timeout_s": (float, 30.0, "lease request timeout"),
     # --- worker pool ---
     "num_prestart_workers": (int, 0, "workers to pre-start at node boot (0 = num_cpus)"),

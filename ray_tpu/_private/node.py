@@ -101,6 +101,10 @@ class _TaskRecord:
     # when a task's user threads block concurrently — the first
     # unblock would re-charge while others still wait)
     blocked_depth: int = 0
+    # when this record entered the local pending queue — a task starved
+    # here past the spillback delay gets re-routed if capacity opened
+    # elsewhere
+    queued_at: float = field(default_factory=time.monotonic)
     # exclusive TPU slot indices held while running (whole-chip demands)
     accel_ids: Optional[List[int]] = None
 
@@ -393,6 +397,14 @@ class NodeService:
         self._env_spawn_error: Dict[str, str] = {}
 
         self._pending = _PendingQueue(self._rec_env_key)  # ready-to-dispatch
+        # True while draining a SUBMIT_BATCH: _queue_local defers its
+        # per-spec _dispatch so the burst is one scheduling pass
+        self._in_batch = False
+        # resources routed to a peer but not yet visible in its gossiped
+        # availability: {node_id: [(monotonic_ts, resources), ...]}.
+        # Subtracted from _candidates so a burst doesn't pile onto one
+        # node through a stale view (RaySyncer-staleness bridge).
+        self._route_debits: Dict[NodeID, List[Tuple[float, Dict[str, float]]]] = {}
         self._waiting_deps: Dict[TaskID, _TaskRecord] = {}
         self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
         self._running: Dict[TaskID, _TaskRecord] = {}
@@ -400,6 +412,11 @@ class NodeService:
 
         self._actors: Dict[ActorID, dict] = {}            # local actor state
         self._actor_queues: Dict[ActorID, deque] = {}
+        # owners with a dep-waiting call in flight per actor: later calls
+        # from the same owner must NOT overtake it — actor tasks execute
+        # in per-submitter order (reference: actor_scheduling_queue.cc
+        # sequence numbers); other owners' calls may interleave freely
+        self._actor_blocked_owners: Dict[ActorID, set] = {}
 
         self._get_waiters: Dict[int, _Waiter] = {}
         self._wait_waiters: Dict[int, _Waiter] = {}
@@ -703,6 +720,7 @@ class NodeService:
         self._reap_idle_workers()
         self._check_memory_pressure()
         self._retry_infeasible()
+        self._spill_starved_pending()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
         self._dispatch()
@@ -999,6 +1017,8 @@ class NodeService:
             self._reroute_actor(item[1])
         elif kind == "actor_parked_flush":
             self._flush_parked_actor_calls(item[1])
+        elif kind == "spillback_task":
+            self._on_spillback_task(item[1], item[2])
         elif kind == "timer":
             item[1]()
 
@@ -1025,6 +1045,16 @@ class NodeService:
                 self._driver_conn_keys.add(key)
         elif op == P.SUBMIT_TASK:
             self._submit_task(payload)
+        elif op == P.SUBMIT_BATCH:
+            # coalesced submissions: queue them all, then dispatch once —
+            # a 100-task burst is one scheduling pass, not 100
+            self._in_batch = True
+            try:
+                for sub_op, spec in payload:
+                    self._handle_msg(key, sub_op, spec)
+            finally:
+                self._in_batch = False
+            self._dispatch()
         elif op == P.CREATE_ACTOR:
             self._create_actor(payload)
         elif op == P.SUBMIT_ACTOR_TASK:
@@ -1113,22 +1143,56 @@ class NodeService:
             pass
 
     # ----------------------------------------------------------- submission
+    def _debit_route(self, target: NodeID, resources: Dict[str, float]) -> None:
+        """Remember resources just routed to a peer so the next routing
+        decision doesn't see them as still free (gossiped availability
+        lags by up to a heartbeat)."""
+        if resources:
+            self._route_debits.setdefault(target, []).append(
+                (time.monotonic(), resources))
+
     def _candidates(self):
         out = []
+        now = time.monotonic()
+        ttl = CONFIG.scheduler_route_debit_ttl_s
+        seen = set()
         for info in self.gcs.alive_nodes():
+            seen.add(info.node_id)
             svc = info.service
             if svc is not None:
                 if svc.dead:
                     continue
+                # same-process node: availability is exact; just TTL-out
+                # any debits so the dict doesn't grow with routed tasks
                 avail = svc.available_snapshot()
+                self._prune_debits(info.node_id, now, ttl)
             else:
                 # remote process: availability from heartbeat gossip
-                # (RaySyncer-equivalent; staleness is absorbed by the
-                # target node's pending queue)
+                # (RaySyncer-equivalent); subtract what we routed there
+                # within the debit ttl so a burst doesn't herd onto one
+                # node through the stale view
                 avail = dict(info.resources_available
                              or info.resources_total)
+                for _, res in self._prune_debits(info.node_id, now, ttl):
+                    for k, v in res.items():
+                        avail[k] = avail.get(k, 0.0) - v
             out.append((info.node_id, dict(info.resources_total), avail))
+        # nodes that left the cluster take their debit history with them
+        for nid in list(self._route_debits):
+            if nid not in seen:
+                del self._route_debits[nid]
         return out
+
+    def _prune_debits(self, nid: NodeID, now: float, ttl: float) -> list:
+        debits = self._route_debits.get(nid)
+        if not debits:
+            return []
+        live = [(ts, res) for ts, res in debits if now - ts < ttl]
+        if live:
+            self._route_debits[nid] = live
+        else:
+            del self._route_debits[nid]
+        return live
 
     def _peer(self, node_id: NodeID):
         """Handle to a node: self, an in-process NodeService, or a
@@ -1183,14 +1247,18 @@ class NodeService:
         self._pin_submission(spec.task_id, self._arg_refs(spec), spec)
         self._route_task(spec)
 
-    def _route_task(self, spec: P.TaskSpec) -> None:
+    def _route_task(self, spec: P.TaskSpec,
+                    exclude: Optional[Set[NodeID]] = None) -> None:
         strategy = spec.scheduling_strategy
         if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
             target = self._pg_target_node(strategy)
         else:
+            cands = self._candidates()
+            if exclude:
+                filtered = [c for c in cands if c[0] not in exclude]
+                cands = filtered or cands
             target = sched.pick_node(spec.resources, strategy or sched.DEFAULT,
-                                     self._candidates(), self.node_id,
-                                     self._rng)
+                                     cands, self.node_id, self._rng)
         owned = self._owned.get(spec.task_id)
         if target is None:
             if not self._park_infeasible("task", spec):
@@ -1199,6 +1267,8 @@ class NodeService:
             return
         if owned:
             owned.assigned_node = target
+        # a starved target spills the task back here for re-routing
+        spec.origin_node_id = self.node_id.binary()
         if target == self.node_id:
             self._queue_local(spec, "task")
         else:
@@ -1207,6 +1277,7 @@ class NodeService:
                 self._fail_returns(spec, exceptions.WorkerCrashedError(
                     "target node died before dispatch"))
                 return
+            self._debit_route(target, spec.resources)
             peer.post_remote(("remote_task", spec))
 
     def _pg_target_node(self, strategy) -> Optional[NodeID]:
@@ -1243,7 +1314,8 @@ class NodeService:
             self._waiting_deps[spec.task_id] = rec
         else:
             self._pending.append(rec)
-            self._dispatch()
+            if not self._in_batch:
+                self._dispatch()
 
     def _add_dep(self, rec: _TaskRecord, oid: ObjectID) -> None:
         meta = self._lookup_object(oid)
@@ -1453,6 +1525,74 @@ class NodeService:
         # tasks pending in this pass, not to the env forever
         for env in failed_envs:
             self._env_spawn_failures.pop(env, None)
+
+    def _spill_starved_pending(self) -> None:
+        """Re-route queued tasks that have starved locally while another
+        node has free capacity (reference: lease spillback,
+        ``cluster_task_manager.cc`` — a lease that can't be served locally
+        is redirected rather than parked forever). Without this, a stale
+        routing view can strand a task behind a long-running occupant
+        while the rest of the cluster idles."""
+        delay = CONFIG.scheduler_spillback_delay_s
+        if delay <= 0 or not self._pending:
+            return
+        now = time.monotonic()
+        cands = None
+        spilled = 0
+        for shape in self._pending.shapes():
+            if spilled >= 10:      # bound per-tick dispatcher work
+                break
+            bucket = self._pending.bucket(shape)
+            if not bucket:
+                continue
+            rec = bucket[0]
+            if (rec.cancelled or rec.pg_key is not None
+                    or rec.kind != "task"
+                    or now - rec.queued_at < delay):
+                continue
+            strategy = rec.spec.scheduling_strategy
+            if (isinstance(strategy, sched.NodeAffinitySchedulingStrategy)
+                    and not strategy.soft):
+                continue
+            if self._try_acquire(rec):
+                # fits locally after all — dispatch will pick it up
+                self._release_charge(rec)
+                continue
+            if cands is None:
+                cands = self._candidates()
+            fit_now = [(nid, total, avail) for nid, total, avail in cands
+                       if nid != self.node_id
+                       and sched.fits(avail, rec.spec.resources)]
+            if not fit_now:
+                continue
+            self._pending.remove(rec)
+            spilled += 1
+            origin = (NodeID(rec.spec.origin_node_id)
+                      if rec.spec.origin_node_id else self.node_id)
+            if origin == self.node_id:
+                # we own the routing decision: re-route, away from here
+                self._route_task(rec.spec, exclude={self.node_id})
+            else:
+                peer = self._peer(origin)
+                if peer is None:
+                    # origin died; node-death handling owns the retry —
+                    # put the task back rather than dropping it
+                    self._pending.append(rec)
+                    spilled -= 1
+                    continue
+                peer.post_remote(("spillback_task", rec.spec, self.node_id))
+
+    def _on_spillback_task(self, spec: P.TaskSpec,
+                           starved_node: NodeID) -> None:
+        """Owner-side: a target couldn't serve a task we routed to it and
+        capacity exists elsewhere — route it again, avoiding the starved
+        node."""
+        owned = self._owned.get(spec.task_id)
+        if owned is None or owned.done:
+            return                       # completed or cancelled meanwhile
+        if owned.assigned_node != starved_node:
+            return                       # stale spillback (already moved)
+        self._route_task(spec, exclude={starved_node})
 
     def _fail_pending_rec(self, rec: _TaskRecord, exc: Exception) -> None:
         """Fail a queued (never-dispatched) task record."""
@@ -1877,7 +2017,12 @@ class NodeService:
                 if rec.kind == "actor_call_waiting":
                     rec.kind = "actor_call"
                     self._send_actor_call(rec)
+                    self._unblock_actor_owner(rec.spec)
                 else:
+                    # pending-queue starvation is measured from HERE, not
+                    # record creation — dep-wait time must not trigger an
+                    # immediate locality-losing spillback
+                    rec.queued_at = time.monotonic()
                     self._pending.append(rec)
         # resolve client waiters
         for waiter_id in list(self._obj_waiter_index.pop(oid, ())):
@@ -1922,7 +2067,9 @@ class NodeService:
         strategy = spec.scheduling_strategy
         if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
             return self._pg_target_node(strategy)
-        return sched.pick_node(spec.resources, strategy or sched.DEFAULT,
+        demand = (self._creation_demand(spec)
+                  if isinstance(spec, P.ActorSpec) else spec.resources)
+        return sched.pick_node(demand, strategy or sched.DEFAULT,
                                self._candidates(), self.node_id, self._rng)
 
     def _route_actor(self, spec: P.ActorSpec) -> None:
@@ -1946,6 +2093,7 @@ class NodeService:
                         object_id=spec.creation_return_id, size=len(err),
                         error=err))
                 return
+            self._debit_route(target, spec.resources)
             peer.post_remote(("remote_actor_create", spec))
 
     def _fail_queued_actor_tasks(self, actor_id: ActorID,
@@ -1956,6 +2104,7 @@ class NodeService:
             qspec = q.popleft()
             self._fail_returns(qspec, exceptions.ActorDiedError(
                 actor_id, reason))
+        self._actor_blocked_owners.pop(actor_id, None)
 
     def _creation_task_spec(self, spec: P.ActorSpec) -> P.TaskSpec:
         return P.TaskSpec(
@@ -1966,8 +2115,23 @@ class NodeService:
             args=spec.args, kwargs=spec.kwargs,
             num_returns=1,
             return_ids=[spec.creation_return_id] if spec.creation_return_id else [],
-            resources=spec.resources,
+            resources=self._creation_demand(spec),
             scheduling_strategy=spec.scheduling_strategy)
+
+    @staticmethod
+    def _creation_demand(spec: P.ActorSpec) -> Dict[str, float]:
+        """Resource demand of the actor CREATION task. Reference
+        semantics (``actor.py:384``): an actor with no explicit
+        resources charges 1 CPU while its __init__ runs — gating
+        concurrent creations — and 0 afterwards (the charge is released
+        in ``_actor_creation_done``). PG-scheduled actors draw from
+        their bundle, where an implicit CPU may not exist."""
+        if spec.resources:
+            return spec.resources
+        if isinstance(spec.scheduling_strategy,
+                      sched.PlacementGroupSchedulingStrategy):
+            return {}
+        return {"CPU": 1.0}
 
     def _local_create_actor(self, spec: P.ActorSpec) -> None:
         self._actors[spec.actor_id] = {
@@ -1997,15 +2161,22 @@ class NodeService:
                 w.actor_id = None
                 self._mark_idle(w)
             return
-        # actor keeps its resource charge (and TPU slots) for its lifetime
+        # actor keeps its resource charge (and TPU slots) for its
+        # lifetime — except the implicit creation-only 1 CPU (see
+        # _creation_demand), which is returned now that __init__ is done
         if st is not None:
             st["state"] = ACTOR_ALIVE
             st["worker_id"] = rec.worker_id
-            st["charge"] = rec.charge
             st["pg_key"] = rec.pg_key
-            st["accel_ids"] = rec.accel_ids
-            rec.accel_ids = None    # ownership moved: rec release must
-            rec.charge = None       # not double-return them
+            if spec.resources:
+                st["charge"] = rec.charge
+                st["accel_ids"] = rec.accel_ids
+                rec.accel_ids = None   # ownership moved: rec release
+                rec.charge = None      # must not double-return them
+            else:
+                self._release_charge(rec)
+                st["charge"] = None
+                st["accel_ids"] = None
         w = self._workers.get(rec.worker_id)
         if w is not None:
             w.task = None
@@ -2060,8 +2231,15 @@ class NodeService:
         w = self._workers.get(st["worker_id"])
         if w is None or w.conn is None:
             return
+        blocked = self._actor_blocked_owners.setdefault(actor_id, set())
+        held = []            # calls parked behind a same-owner dep wait
         while q:
             spec = q.popleft()
+            if spec.owner_id in blocked:
+                # an earlier call from this submitter is dep-waiting: a
+                # stateful actor must not observe call N+1 before call N
+                held.append(spec)
+                continue
             rec = _TaskRecord(spec=spec, kind="actor_call", worker_id=w.worker_id)
             # resolve deps inline; actor calls with unresolved deps wait
             unresolved = False
@@ -2076,8 +2254,19 @@ class NodeService:
             if unresolved:
                 self._waiting_deps[spec.task_id] = rec
                 rec.kind = "actor_call_waiting"
+                blocked.add(spec.owner_id)
                 continue
             self._send_actor_call(rec)
+        if held:
+            q.extendleft(reversed(held))
+
+    def _unblock_actor_owner(self, spec: P.TaskSpec) -> None:
+        """A dep-waiting call from this submitter left the wait state
+        (sent, failed, or cancelled): release the calls held behind it."""
+        blocked = self._actor_blocked_owners.get(spec.actor_id)
+        if blocked is not None and spec.owner_id in blocked:
+            blocked.discard(spec.owner_id)
+            self._flush_actor_queue(spec.actor_id)
 
     def _send_actor_call(self, rec: _TaskRecord) -> None:
         st = self._actors.get(rec.spec.actor_id)
@@ -2086,11 +2275,14 @@ class NodeService:
                 rec.spec.actor_id, "actor is dead"))
             return
         if st["state"] != ACTOR_ALIVE:
-            self._actor_queues[rec.spec.actor_id].append(rec.spec)
+            # head of the queue, not tail: this call is older than any
+            # same-owner call already queued (it blocked them while
+            # dep-waiting), and per-owner order must survive a restart
+            self._actor_queues[rec.spec.actor_id].appendleft(rec.spec)
             return
         w = self._workers.get(st["worker_id"])
         if w is None or w.conn is None:
-            self._actor_queues[rec.spec.actor_id].append(rec.spec)
+            self._actor_queues[rec.spec.actor_id].appendleft(rec.spec)
             return
         self._running[rec.spec.task_id] = rec
         self._record_event(rec.spec, "RUNNING")
@@ -2271,6 +2463,8 @@ class NodeService:
 
     def _local_cancel(self, task_id: TaskID, force: bool) -> None:
         rec = self._waiting_deps.pop(task_id, None)
+        if rec is not None and rec.kind == "actor_call_waiting":
+            self._unblock_actor_owner(rec.spec)
         if rec is None:
             for r in self._pending:
                 if r.spec.task_id == task_id:

@@ -89,6 +89,11 @@ NOTIFY_UNBLOCKED = 55   # no payload
 OBJ_PULL_CHUNK = 56     # (req_id, ObjectID, offset, length)
                         # -> INFO_REPLY (meta, bytes|None)|None
 
+# Coalesced submission stream: [(SUBMIT_TASK|SUBMIT_ACTOR_TASK, spec),
+# ...] — one frame + one dispatcher wakeup per burst (reference
+# analogue: the C++ submit queue amortizing per-call overhead)
+SUBMIT_BATCH = 57
+
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
 GET_REPLY = 41          # (req_id, [ObjectMeta])
@@ -108,6 +113,23 @@ KIND_WORKER = 1
 
 
 # ------------------------------------------------------------------- specs
+
+def _mk_task_spec(t: tuple) -> "TaskSpec":
+    """Rebuild a TaskSpec from its flattened wire tuple (see
+    ``TaskSpec.__reduce__``). Positional layout == dataclass field
+    order; __new__ + direct assignment skips __init__ overhead."""
+    s = TaskSpec.__new__(TaskSpec)
+    (tid, jid, s.name, s.function_id, s.args, s.kwargs, s.num_returns,
+     rids, s.resources, s.max_retries, s.retry_exceptions, aid,
+     s.method_name, s.seq_no, s.scheduling_strategy, s.owner_id,
+     s.origin_node_id, s.namespace, s.runtime_env, s.trace_context,
+     s.accel_ids) = t
+    s.task_id = TaskID(tid)
+    s.job_id = JobID(jid)
+    s.return_ids = [ObjectID(b) for b in rids]
+    s.actor_id = ActorID(aid) if aid is not None else None
+    return s
+
 
 @dataclass
 class TaskSpec:
@@ -136,6 +158,10 @@ class TaskSpec:
     # scheduling
     scheduling_strategy: Any = None          # None | "SPREAD" | NodeAffinity | PG
     owner_id: bytes = b""                    # WorkerID binary of the submitter
+    # NodeID binary of the node that owns/routes this task; a starved
+    # target spills the task back here for re-routing (reference
+    # analogue: lease spillback keeps the owner in the loop)
+    origin_node_id: bytes = b""
     namespace: str = "default"               # submitter's job namespace
     runtime_env: Optional[dict] = None       # validated runtime env
     # tracing: caller's (trace_id, span_id), propagated into the worker
@@ -145,6 +171,23 @@ class TaskSpec:
     # dispatch (reference: resource-instance ids / GPU id assignment);
     # read via get_runtime_context().get_accelerator_ids()
     accel_ids: Optional[List[int]] = None
+
+    def __reduce__(self):
+        # Hot-path serialization: a task spec crosses the wire 2-3 times
+        # per invocation (submit, dispatch, peer forward). The default
+        # dataclass pickle costs ~28us/spec (per-object reduce of every
+        # ID); flattening to one tuple with IDs as raw bytes is ~8us.
+        # tests/test_core_basic.py::test_spec_wire_roundtrip guards the
+        # field list against drift.
+        return (_mk_task_spec, (
+            (self.task_id.binary(), self.job_id.binary(), self.name,
+             self.function_id, self.args, self.kwargs, self.num_returns,
+             [r.binary() for r in self.return_ids], self.resources,
+             self.max_retries, self.retry_exceptions,
+             self.actor_id.binary() if self.actor_id else None,
+             self.method_name, self.seq_no, self.scheduling_strategy,
+             self.owner_id, self.origin_node_id, self.namespace,
+             self.runtime_env, self.trace_context, self.accel_ids),))
 
 
 @dataclass

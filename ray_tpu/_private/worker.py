@@ -264,7 +264,9 @@ class WorkerRuntime:
             for oid, value in zip(spec.return_ids, values):
                 metas.append(self._store_return(oid, value))
         # borrows registered during execution must land BEFORE the
-        # node unpins this task's args (same conn => ordered frames)
+        # node unpins this task's args (same conn => ordered frames);
+        # buffered nested submissions likewise precede our DONE
+        self.client.flush_submissions()
         self.client.flush_refs()
         self.conn.send((P.TASK_DONE, (spec.task_id, metas, err_bytes, kind)))
         # unconditional: force-traced spans exist even when THIS node's

@@ -20,7 +20,12 @@ from ._private.ids import ActorID, ObjectID
 from ._private.object_ref import ObjectRef
 
 _DEFAULT_TASK_CPUS = 1.0
-_DEFAULT_ACTOR_CPUS = 1.0
+# Alive actors hold NO cpu by default (reference semantics: the implicit
+# 1 CPU applies to the creation task only — ``actor.py:384`` "num_cpus:
+# ... default 1 for creation, 0 for running"). A lifetime CPU charge per
+# actor starves task dispatch on small nodes; explicit num_cpus= still
+# reserves for the actor's lifetime.
+_DEFAULT_ACTOR_CPUS = 0.0
 
 
 def _build_resources(opts: Dict[str, Any], default_cpus: float) -> Dict[str, float]:

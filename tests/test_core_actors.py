@@ -216,3 +216,60 @@ def test_actor_crash_in_init_restart_then_ready(rtpu_init):
     assert ray_tpu.get(h._ready_ref, timeout=30) is None
     assert ray_tpu.get(h.ping.remote(), timeout=20) == "pong"
     _os.unlink(marker)
+
+
+def test_actor_call_ordering_with_dep_race(rtpu_init):
+    """A dep-waiting actor call must BLOCK later calls from the same
+    submitter: a stateful actor can never observe call N+1 before call N
+    (reference: actor_scheduling_queue.cc per-submitter sequence order)."""
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(1.0)
+        return 41
+
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.calls = []
+
+        def record(self, tag, _dep=None):
+            self.calls.append(tag)
+            return list(self.calls)
+
+    log = Log.remote()
+    assert ray_tpu.get(log.record.remote("warmup")) == ["warmup"]
+    dep = slow_value.remote()          # resolves ~1s from now
+    log.record.remote("first", dep)    # parks waiting on dep
+    r2 = log.record.remote("second")   # must NOT overtake "first"
+    assert ray_tpu.get(r2, timeout=30) == ["warmup", "first", "second"]
+
+
+def test_actor_dep_wait_does_not_block_other_submitters(rtpu_init):
+    """Per-submitter order only: another submitter's calls may interleave
+    while the first submitter's call waits on its dep."""
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(2.0)
+        return 1
+
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.calls = []
+
+        def record(self, tag, _dep=None):
+            self.calls.append(tag)
+            return list(self.calls)
+
+    @ray_tpu.remote
+    def other_submitter(handle):
+        return ray_tpu.get(handle.record.remote("other"))
+
+    log = Log.remote()
+    assert ray_tpu.get(log.record.remote("warmup")) == ["warmup"]
+    dep = slow_value.remote()
+    log.record.remote("driver-blocked", dep)
+    # a DIFFERENT submitter (the task worker) must get through while the
+    # driver's call still waits on its dep
+    out = ray_tpu.get(other_submitter.remote(log), timeout=15)
+    assert out == ["warmup", "other"]

@@ -326,3 +326,47 @@ def test_accelerator_slot_assignment():
             {"TPU": []}
     finally:
         ray_tpu.shutdown()
+
+
+def test_spec_wire_roundtrip():
+    """TaskSpec/ObjectMeta use hand-flattened __reduce__ tuples for wire
+    speed; this guards the field lists against drifting from the
+    dataclass definitions (a missed field would silently reset to its
+    default on the receiving side)."""
+    import dataclasses
+    import pickle
+
+    from ray_tpu._private import protocol as P
+    from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
+                                      TaskID, WorkerID)
+    from ray_tpu._private.object_store import ObjectMeta
+
+    job = JobID.from_random()
+    tid = TaskID.for_job(job)
+    spec = P.TaskSpec(
+        task_id=tid, job_id=job, name="n", function_id=b"f" * 16,
+        args=[("v", 1)], kwargs={"k": ("r", ObjectID.from_random())},
+        num_returns=2,
+        return_ids=[ObjectID.for_task_return(tid, i) for i in range(2)],
+        resources={"CPU": 2.0}, max_retries=3, retry_exceptions=True,
+        actor_id=ActorID.from_random(), method_name="m", seq_no=7,
+        scheduling_strategy="SPREAD",
+        owner_id=WorkerID.from_random().binary(),
+        origin_node_id=NodeID.from_random().binary(), namespace="ns",
+        runtime_env={"env_vars": {"A": "1"}}, trace_context={"t": 1},
+        accel_ids=[0, 1])
+    # every field set to a NON-default value above; fail if a new field
+    # was added without updating this test + __reduce__
+    for f in dataclasses.fields(P.TaskSpec):
+        assert getattr(spec, f.name) != f.default or f.name == "name", \
+            f"give field {f.name!r} a non-default value in this test"
+    back = pickle.loads(pickle.dumps(spec, protocol=5))
+    for f in dataclasses.fields(P.TaskSpec):
+        assert getattr(back, f.name) == getattr(spec, f.name), f.name
+
+    meta = ObjectMeta(object_id=ObjectID.from_random(), size=9,
+                      inline=b"x", shm_name="s", error=b"e",
+                      node_hint=b"n" * 16, arena_ref=("/p", 4))
+    mback = pickle.loads(pickle.dumps(meta, protocol=5))
+    for f in dataclasses.fields(ObjectMeta):
+        assert getattr(mback, f.name) == getattr(meta, f.name), f.name

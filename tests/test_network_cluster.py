@@ -349,3 +349,62 @@ def test_actor_restarts_on_surviving_node_after_node_death(tcp_cluster):
     assert out >= 1                          # fresh state, restarted
     new_home = ray_tpu.get(p.where.remote(), timeout=30)
     assert new_home != victim.node_id_hex
+
+
+def test_spillback_rescues_starved_task():
+    """A task queued behind a long occupant must re-route once capacity
+    opens on another node (reference: lease spillback,
+    ``cluster_task_manager.cc``) instead of starving while the rest of
+    the cluster idles."""
+    cluster = Cluster(initialize_head=True, process_isolated=True,
+                      head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=1)
+    ray_tpu.init(address=cluster)
+    try:
+        @ray_tpu.remote
+        def busy(t):
+            time.sleep(t)
+            return time.time()
+
+        t0 = time.time()
+        busy.remote(12.0)                 # fills one node for a long time
+        short = busy.remote(2.0)          # fills the other briefly
+        time.sleep(0.5)                   # both running: cluster is full
+        third = busy.remote(0.0)          # queued behind one of them
+        done = ray_tpu.get(third, timeout=30) - t0
+        # without spillback there is a ~50% chance third waits 12s on the
+        # long node; with it, it must run soon after the short task frees
+        assert done < 7.0, f"queued task starved {done:.1f}s"
+        ray_tpu.get(short)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_burst_does_not_pile_on_one_node():
+    """Route-time debits: a burst routed within one heartbeat must fan
+    out across nodes instead of herding onto the node the stale view
+    says is free (RaySyncer-staleness bridge)."""
+    cluster = Cluster(initialize_head=True, process_isolated=True,
+                      head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster)
+    try:
+        @ray_tpu.remote
+        def spin(t):
+            time.sleep(t)
+            return time.time()
+
+        _wait_for_nodes(3)
+        t0 = time.time()
+        # 6 tasks == exactly the cluster's CPU capacity, submitted as one
+        # burst: they should all run concurrently (one per CPU slot)
+        refs = [spin.remote(2.0) for _ in range(6)]
+        ends = ray_tpu.get(refs, timeout=60)
+        # if they herded onto one 2-CPU node they'd serialize into 3
+        # waves (~6s); spread across nodes the whole batch takes ~1 wave
+        assert max(ends) - t0 < 5.0, f"burst serialized: {max(ends)-t0:.1f}s"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
