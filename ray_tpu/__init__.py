@@ -26,7 +26,7 @@ from ._private.config import CONFIG
 from ._private.gcs import GlobalControlPlane, JobRecord
 from ._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID  # noqa: F401
 from ._private.node import NodeService
-from ._private.object_ref import ObjectRef
+from ._private.object_ref import ObjectRef, ObjectRefGenerator
 from .api import ActorClass, ActorHandle, RemoteFunction, method, remote  # noqa: F401
 from .runtime_context import get_runtime_context  # noqa: F401
 

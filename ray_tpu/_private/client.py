@@ -21,7 +21,7 @@ from .. import exceptions
 from . import protocol as P
 from .config import CONFIG
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
-from .object_ref import ObjectRef
+from .object_ref import ObjectRef, ObjectRefGenerator
 from .object_store import ObjectMeta, ObjectReader, create_segment
 from . import serialization as ser
 
@@ -78,6 +78,11 @@ class CoreClient:
         # client, so cross-op ordering is exactly the unbatched order.
         self._sub_buf: List[Tuple[int, Any]] = []
         self._sub_lock = threading.Lock()
+        # streaming-generator producer credit: {task_id: [consumed, Event]}
+        # updated by GEN_ACK pushes; the executing thread waits on the
+        # Event when its in-flight window fills
+        self._gen_credit: Dict[TaskID, list] = {}
+        self._gen_credit_lock = threading.Lock()
 
     # ------------------------------------------------------------ refcounts
     def ref_incr(self, oid: ObjectID) -> None:
@@ -203,6 +208,24 @@ class CoreClient:
             fut = self._futures.pop(req_id, None)
             if fut is not None:
                 fut.set_exception(ser.from_bytes(err))
+        elif op == P.GEN_ACK:
+            task_id, consumed = payload
+            with self._gen_credit_lock:
+                # Normal acks are update-only: production acks can't
+                # precede gen_credit_init (items ship after it), and
+                # creating on a late ack would leak one entry per stream
+                # in a pooled worker. The synthetic INFINITE credit of an
+                # early GEN_CLOSE is the exception — it may arrive before
+                # the task even starts, and must survive until init's
+                # setdefault finds it (gen_credit_drop then removes it).
+                ev = self._gen_credit.get(task_id)
+                if ev is None and consumed >= (1 << 62):
+                    ev = self._gen_credit[task_id] = [consumed,
+                                                      threading.Event()]
+                elif ev is not None and consumed > ev[0]:
+                    ev[0] = consumed
+                if ev is not None:
+                    ev[1].set()
         elif op == P.EVENT:
             channel, data = payload
             if channel == "LOG" and self.kind == P.KIND_DRIVER:
@@ -273,6 +296,43 @@ class CoreClient:
             self.flush_submissions()
         else:
             self._ensure_flusher()
+
+    def gen_next(self, task_id: TaskID, index: int):
+        """Consumer side: block until item ``index`` of a streaming task
+        is available; returns ("item", meta) | ("end", count) |
+        ("error", err_bytes)."""
+        fut = self._request(P.GEN_NEXT, lambda rid: (rid, task_id, index))
+        return self._blocking_result(fut)
+
+    def gen_close(self, task_id: TaskID) -> None:
+        self._send(P.GEN_CLOSE, (task_id,))
+
+    def gen_credit_init(self, task_id: TaskID) -> None:
+        """Register the credit slot BEFORE the first item ships: acks
+        may arrive before the producer's first wait, and dropping them
+        would deadlock a producer at exactly ``window`` items."""
+        with self._gen_credit_lock:
+            self._gen_credit.setdefault(task_id, [0, threading.Event()])
+
+    def gen_wait_credit(self, task_id: TaskID, produced: int,
+                        window: int) -> None:
+        """Producer-side backpressure: block until the consumer has
+        acked enough items that fewer than ``window`` are in flight.
+        GEN_ACK pushes (handled on the worker's recv thread) advance the
+        credit."""
+        if window <= 0:
+            return
+        while not self._closed.is_set():
+            with self._gen_credit_lock:
+                ent = self._gen_credit.get(task_id)
+                if ent is None or produced - ent[0] < window:
+                    return
+                ent[1].clear()
+            ent[1].wait(timeout=1.0)
+
+    def gen_credit_drop(self, task_id: TaskID) -> None:
+        with self._gen_credit_lock:
+            self._gen_credit.pop(task_id, None)
 
     def flush_submissions(self) -> None:
         # send while holding the lock: a concurrent later submission must
@@ -527,13 +587,18 @@ class CoreClient:
                     runtime_env: Optional[dict] = None) -> List[ObjectRef]:
         task_id = TaskID.for_job(self.job_id)
         packed, pkw = self.pack_args(args, kwargs)
-        return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+        streaming = num_returns == -1
+        return_ids = ([] if streaming
+                      else [ObjectID.for_task_return(task_id, i)
+                            for i in range(num_returns)])
         spec = P.TaskSpec(
             task_id=task_id, job_id=self.job_id, name=name,
             function_id=function_id, args=packed, kwargs=pkw,
             num_returns=num_returns, return_ids=return_ids,
-            resources=resources, max_retries=max_retries,
+            resources=resources,
+            # no lineage reconstruction of partially-consumed streams
+            # (the reference restricts retries of generators similarly)
+            max_retries=0 if streaming else max_retries,
             retry_exceptions=retry_exceptions,
             scheduling_strategy=scheduling_strategy,
             owner_id=self.worker_id.binary(),
@@ -541,6 +606,8 @@ class CoreClient:
             runtime_env=runtime_env,
             trace_context=self._trace_context())
         self._send_submission(P.SUBMIT_TASK, spec)
+        if streaming:
+            return ObjectRefGenerator(task_id)
         return [ObjectRef(oid) for oid in return_ids]
 
     @staticmethod
@@ -559,8 +626,10 @@ class CoreClient:
                           name: str = "") -> List[ObjectRef]:
         task_id = TaskID.for_job(self.job_id)
         packed, pkw = self.pack_args(args, kwargs)
-        return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+        streaming = num_returns == -1
+        return_ids = ([] if streaming
+                      else [ObjectID.for_task_return(task_id, i)
+                            for i in range(num_returns)])
         spec = P.TaskSpec(
             task_id=task_id, job_id=self.job_id,
             name=name or method_name, function_id=b"",
@@ -571,6 +640,8 @@ class CoreClient:
             namespace=self._active_namespace(),
             trace_context=self._trace_context())
         self._send_submission(P.SUBMIT_ACTOR_TASK, spec)
+        if streaming:
+            return ObjectRefGenerator(task_id)
         return [ObjectRef(oid) for oid in return_ids]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:

@@ -41,6 +41,11 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                     "resources stay debited from the router's "
                                     "view of the target node (bridges heartbeat "
                                     "staleness so bursts don't pile onto one node)"),
+    "generator_backpressure_window": (int, 16,
+                                      "max unconsumed streaming-generator items "
+                                      "in flight before the producer blocks "
+                                      "(0 = unbounded; reference: "
+                                      "_generator_backpressure_num_objects)"),
     "scheduler_spillback_delay_s": (float, 0.25,
                                     "re-route a queued task to another node with "
                                     "free capacity after it has starved locally "

@@ -155,6 +155,8 @@ class GlobalControlPlane:
         self.placement_groups: Dict[PlacementGroupID, dict] = {}
         # object directory: object -> (node_id, meta)
         self.directory: Dict[ObjectID, Tuple[NodeID, ObjectMeta]] = {}
+        # streaming-return counters per producing task (see gen_update)
+        self.gen_streams: Dict[TaskID, dict] = {}
         self.task_events: deque = deque(maxlen=CONFIG.task_events_buffer_size)
         self.cluster_events: deque = deque(
             maxlen=CONFIG.cluster_events_buffer_size)
@@ -456,6 +458,56 @@ class GlobalControlPlane:
     def drop_location(self, object_id: ObjectID) -> None:
         with self._lock:
             self.directory.pop(object_id, None)
+
+    # ------------------------------------------------- generator streams
+    # Streaming-return bookkeeping (reference: the owner-side generator
+    # state driven by ReportGeneratorItemReturns,
+    # ``core_worker.proto:396``). Item payloads are ordinary directory
+    # objects; this records only produced/consumed/done counters so a
+    # consumer on any node can pace the producer.
+
+    def gen_update(self, task_id: TaskID, produced: int) -> None:
+        with self._lock:
+            st = self.gen_streams.setdefault(
+                task_id, {"produced": 0, "consumed": 0, "done": False,
+                          "count": None, "error": None})
+            if produced > st["produced"]:
+                st["produced"] = produced
+        self.publish("GEN", (task_id, "produced", produced))
+
+    def gen_done(self, task_id: TaskID, count: int,
+                 error: Optional[bytes]) -> None:
+        with self._lock:
+            st = self.gen_streams.setdefault(
+                task_id, {"produced": 0, "consumed": 0, "done": False,
+                          "count": None, "error": None})
+            st["done"] = True
+            st["count"] = count
+            st["error"] = error
+            st["produced"] = max(st["produced"], count)
+        self.publish("GEN", (task_id, "done", count))
+
+    def gen_consumed(self, task_id: TaskID, consumed: int) -> None:
+        with self._lock:
+            # create-on-miss: a GEN_CLOSE can arrive before the first
+            # produced item, and dropping its infinite credit would
+            # wedge the producer at the backpressure window forever
+            st = self.gen_streams.setdefault(
+                task_id, {"produced": 0, "consumed": 0, "done": False,
+                          "count": None, "error": None})
+            if consumed <= st["consumed"]:
+                return
+            st["consumed"] = consumed
+        self.publish("GEN", (task_id, "consumed", consumed))
+
+    def gen_get(self, task_id: TaskID) -> Optional[dict]:
+        with self._lock:
+            st = self.gen_streams.get(task_id)
+            return dict(st) if st is not None else None
+
+    def gen_drop(self, task_id: TaskID) -> None:
+        with self._lock:
+            self.gen_streams.pop(task_id, None)
 
     # ----------------------------------------------------- placement groups
     def register_pg(self, spec: PlacementGroupSpec,

@@ -41,6 +41,7 @@ _ALLOWED = frozenset({
     "record_cluster_event", "list_cluster_events",
     "record_spans", "list_spans", "claim_actor_reroute",
     "requeue_actor_reroute",
+    "gen_update", "gen_done", "gen_consumed", "gen_get", "gen_drop",
 })
 
 
@@ -197,6 +198,7 @@ class RemoteControlPlane:
         "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
         "unpin_task_args", "record_lineage",
         "record_cluster_event", "record_spans",
+        "gen_update", "gen_done", "gen_consumed", "gen_drop",
     })
 
     def __init__(self, address: str):

@@ -116,6 +116,17 @@ class ObjectID(BaseID):
         return cls(task_id.binary()[1:16] + index.to_bytes(1, "big"))
 
     @classmethod
+    def for_gen_item(cls, task_id: "TaskID", index: int):
+        """Dynamic (streaming) return item ids. Layout: task-id entropy
+        [1:11) + 0xFE marker + u32 index — a streaming task can yield up
+        to 2**32 items (reference: ObjectRefGenerator's dynamically
+        allocated return ids, ``_raylet.pyx:252``)."""
+        if index < 0 or index > 0xFFFFFFFF:
+            raise ValueError(f"generator item index out of range: {index}")
+        return cls(task_id.binary()[1:12] + b"\xfe"
+                   + index.to_bytes(4, "big"))
+
+    @classmethod
     def for_put(cls, owner: WorkerID):
         """Layout: KIND + 7 owner-entropy bytes + 8 random, so the owning
         worker is identifiable from the id during debugging/recovery."""

@@ -420,6 +420,14 @@ class NodeService:
 
         self._get_waiters: Dict[int, _Waiter] = {}
         self._wait_waiters: Dict[int, _Waiter] = {}
+        # parked GEN_NEXT requests: {(task_id, index): [(conn_key,
+        # req_id), ...]} — resolved when the item seals or the stream
+        # ends short of the index
+        self._gen_waiters: Dict[tuple, List[Tuple[int, int]]] = {}
+        # last-known consumer credit per stream (from GEN events): a
+        # consumed/close that lands BEFORE the producer task starts here
+        # must still reach the worker — relayed on its first GEN_ITEM
+        self._gen_consumed_cache: Dict[Any, int] = {}
         self._obj_waiter_index: Dict[ObjectID, Set[int]] = {}
         self._next_waiter = 1
 
@@ -513,6 +521,7 @@ class NodeService:
         self.gcs.subscribe("ACTOR", self._on_actor_event)
         self.gcs.subscribe("REF_ZERO", self._on_ref_zero)
         self.gcs.subscribe("LOG", self._on_log_event)
+        self.gcs.subscribe("GEN", self._on_gen_published)
         if CONFIG.log_to_driver:
             t_logs = threading.Thread(
                 target=self._log_tail_loop,
@@ -1019,6 +1028,8 @@ class NodeService:
             self._flush_parked_actor_calls(item[1])
         elif kind == "spillback_task":
             self._on_spillback_task(item[1], item[2])
+        elif kind == "gen_event":
+            self._on_gen_event(item[1])
         elif kind == "timer":
             item[1]()
 
@@ -1082,6 +1093,12 @@ class NodeService:
             self.store.free(payload)
         elif op == P.TASK_DONE:
             self._task_done(key, *payload)
+        elif op == P.GEN_ITEM:
+            self._gen_item(*payload)
+        elif op == P.GEN_NEXT:
+            self._gen_next(key, *payload)
+        elif op == P.GEN_CLOSE:
+            self._gen_close(payload[0])
         elif op == P.KILL_ACTOR:
             self._kill_actor(*payload)
         elif op == P.CANCEL_TASK:
@@ -1971,10 +1988,17 @@ class NodeService:
 
     # ------------------------------------------------------------ completion
     def _task_done(self, conn_key: int, task_id, metas: List[ObjectMeta],
-                   error: Optional[bytes], kind: str) -> None:
+                   error: Optional[bytes], kind: str,
+                   gen_count: Optional[int] = None) -> None:
         rec = self._running.pop(task_id, None)
         if rec is not None:
             self._unpin_deps(rec)
+        if gen_count is not None:
+            # streaming task finished: record the stream end (count +
+            # terminal error) so consumers at any index past the end get
+            # StopIteration/the error instead of waiting forever
+            self.gcs.gen_done(task_id, gen_count, error)
+            self._gen_consumed_cache.pop(task_id, None)
         for meta in metas:
             self._seal_object(meta)
         if rec is None:
@@ -1998,6 +2022,113 @@ class NodeService:
         self.store.adopt(meta)
         self.gcs.publish_location(meta.object_id, self.node_id, meta)
         self.gcs.publish("OBJECT", (meta.object_id, meta))
+
+    # ------------------------------------------------- streaming returns
+    def _gen_item(self, task_id, index: int, meta: ObjectMeta) -> None:
+        """A streaming task produced item ``index`` (reference:
+        ReportGeneratorItemReturns). The item is an ordinary object once
+        sealed; the GEN stream record carries the counters."""
+        self._seal_object(meta)
+        self.gcs.gen_update(task_id, index + 1)
+        consumed = self._gen_consumed_cache.get(task_id)
+        if consumed:
+            # credit that arrived before the task started here
+            self._relay_gen_ack(task_id, consumed)
+        self._resolve_gen_waiters(task_id, index, meta)
+
+    def _relay_gen_ack(self, task_id, consumed: int) -> None:
+        rec = self._running.get(task_id)
+        if rec is not None and rec.worker_id is not None:
+            w = self._workers.get(rec.worker_id)
+            if w is not None and w.conn is not None:
+                try:
+                    w.conn.send((P.GEN_ACK, (task_id, consumed)))
+                except OSError:
+                    pass
+
+    def _resolve_gen_waiters(self, task_id, index: int,
+                             meta: ObjectMeta) -> None:
+        for conn_key, req_id in self._gen_waiters.pop((task_id, index), ()):
+            self._reply(conn_key, P.INFO_REPLY, (req_id, ("item", meta)))
+            self._gen_consume(task_id, index + 1)
+
+    def _gen_next(self, conn_key: int, req_id: int, task_id,
+                  index: int) -> None:
+        oid = ObjectID.for_gen_item(task_id, index)
+        meta = self._lookup_object(oid)
+        if meta is not None:
+            self._reply(conn_key, P.INFO_REPLY, (req_id, ("item", meta)))
+            self._gen_consume(task_id, index + 1)
+            return
+        st = self.gcs.gen_get(task_id)
+        if st is not None and st["done"] and index >= (st["count"] or 0):
+            if st["error"] is not None:
+                self._reply(conn_key, P.INFO_REPLY,
+                            (req_id, ("error", st["error"])))
+            else:
+                self._reply(conn_key, P.INFO_REPLY,
+                            (req_id, ("end", st["count"])))
+            return
+        self._gen_waiters.setdefault((task_id, index), []).append(
+            (conn_key, req_id))
+
+    def _gen_consume(self, task_id, consumed: int) -> None:
+        """Advance the consumer credit; the producer's node relays it as
+        a GEN_ACK to the executing worker (possibly us, see
+        _on_gen_event)."""
+        self.gcs.gen_consumed(task_id, consumed)
+
+    def _gen_close(self, task_id) -> None:
+        """Consumer finished with / dropped its generator: unblock the
+        producer forever (credit -> infinity), drop parked waiters, and
+        drop the control-plane stream record (a late gen_update from a
+        still-running producer recreates it harmlessly — the worker's
+        credit is already infinite)."""
+        self.gcs.gen_consumed(task_id, 1 << 62)
+        for key in [k for k in self._gen_waiters if k[0] == task_id]:
+            del self._gen_waiters[key]
+        self.gcs.gen_drop(task_id)
+
+    def _on_gen_published(self, payload) -> None:
+        self._events.put(("gen_event", payload))
+
+    def _on_gen_event(self, payload) -> None:
+        task_id, kind, n = payload
+        if kind == "consumed":
+            # relay credit to the producer if it runs on this node; also
+            # cache it — if the task hasn't STARTED here yet, the relay
+            # happens on its first GEN_ITEM instead
+            if n > self._gen_consumed_cache.get(task_id, 0):
+                self._gen_consumed_cache[task_id] = n
+            self._relay_gen_ack(task_id, n)
+        elif kind == "done":
+            # stream ended: answer parked waiters at/past the end
+            st = self.gcs.gen_get(task_id)
+            if st is None:
+                return
+            for (tid, index) in [k for k in self._gen_waiters
+                                 if k[0] == task_id and k[1] >= n]:
+                for conn_key, req_id in self._gen_waiters.pop((tid, index)):
+                    if st["error"] is not None:
+                        self._reply(conn_key, P.INFO_REPLY,
+                                    (req_id, ("error", st["error"])))
+                    else:
+                        self._reply(conn_key, P.INFO_REPLY,
+                                    (req_id, ("end", n)))
+        elif kind == "produced":
+            # an item produced on ANOTHER node: its OBJECT publish may
+            # have raced ahead of our waiter registration — re-check
+            index = n - 1
+            waiters = self._gen_waiters.get((task_id, index))
+            if waiters:
+                oid = ObjectID.for_gen_item(task_id, index)
+                meta = self._lookup_object(oid)
+                if meta is not None:
+                    del self._gen_waiters[(task_id, index)]
+                    for conn_key, req_id in waiters:
+                        self._reply(conn_key, P.INFO_REPLY,
+                                    (req_id, ("item", meta)))
+                        self._gen_consume(task_id, index + 1)
 
     def _on_object_published(self, payload) -> None:
         oid, meta = payload
@@ -2039,6 +2170,12 @@ class NodeService:
         for oid in spec.return_ids:
             meta = ObjectMeta(object_id=oid, size=len(err), error=err)
             self._seal_object(meta)
+        if spec.num_returns == -1:
+            # streaming task died mid-production: end the stream with the
+            # error at the next unproduced index so consumers don't hang
+            st = self.gcs.gen_get(spec.task_id)
+            self.gcs.gen_done(spec.task_id,
+                              (st or {}).get("produced", 0), err)
         self.gcs.publish("TASK_FINISHED", {"task_id": spec.task_id,
                                            "ok": False})
 
@@ -2638,6 +2775,19 @@ class NodeService:
         self._driver_conn_keys.discard(key)
         # arena Creates this connection never sealed are garbage now
         self.store.reclaim_unsealed(key)
+        # a dead consumer's parked stream requests: drop the waiters and
+        # release the producers it was pacing (synthesized GEN_CLOSE)
+        dead_streams = set()
+        for (tid, index), waiters in list(self._gen_waiters.items()):
+            kept = [(ck, rid) for ck, rid in waiters if ck != key]
+            if len(kept) != len(waiters):
+                dead_streams.add(tid)
+                if kept:
+                    self._gen_waiters[(tid, index)] = kept
+                else:
+                    del self._gen_waiters[(tid, index)]
+        for tid in dead_streams:
+            self._gen_close(tid)
         # the process died with references: drop them all at once
         held = self._conn_refs.pop(key, None)
         if held:

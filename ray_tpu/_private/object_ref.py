@@ -77,3 +77,65 @@ class ObjectRef:
 
     def __reduce__(self):
         return (ObjectRef, (self.id,))
+
+
+class ObjectRefGenerator:
+    """Consumer handle for a streaming task's dynamic returns
+    (reference: ``_raylet.pyx:252`` ObjectRefGenerator). Iterating
+    yields ObjectRefs one by one as the producer reports them; the item
+    request is what paces the producer's backpressure window. Raises the
+    task's error at the index where production broke; StopIteration at
+    the stream end."""
+
+    def __init__(self, task_id: TaskID):
+        self.task_id = task_id
+        self._index = 0
+        self._count = None          # known stream length once ended
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from . import context
+        from . import serialization as ser
+        if self._count is not None and self._index >= self._count:
+            raise StopIteration
+        client = context.require_client()
+        status, payload = client.gen_next(self.task_id, self._index)
+        if status == "item":
+            ref = ObjectRef(payload.object_id)
+            self._index += 1
+            return ref
+        # terminal: tell the node so it drops the stream record (a
+        # long-lived cluster must not accumulate one per stream)
+        self._close()
+        if status == "end":
+            self._count = payload
+            raise StopIteration
+        # error ends the stream too: a retried next() must raise
+        # StopIteration locally, not park on the dropped record
+        self._count = self._index
+        raise ser.from_bytes(payload)       # status == "error"
+
+    def _close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                from . import context
+                client = context.current_client
+                if client is not None:
+                    client.gen_close(self.task_id)
+            except Exception:   # teardown / closed conn
+                pass
+
+    def __del__(self):
+        self._close()
+
+    def __reduce__(self):
+        # passing a generator between processes would need cross-owner
+        # consumed-index coordination; the reference restricts this too
+        raise TypeError(
+            "ObjectRefGenerator is not picklable; iterate it in the "
+            "process that called .remote(), passing the yielded "
+            "ObjectRefs on instead")

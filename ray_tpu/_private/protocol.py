@@ -94,6 +94,16 @@ OBJ_PULL_CHUNK = 56     # (req_id, ObjectID, offset, length)
 # analogue: the C++ submit queue amortizing per-call overhead)
 SUBMIT_BATCH = 57
 
+# Streaming generator returns (reference: ReportGeneratorItemReturns,
+# ``core_worker.proto:396``; consumer surface ``_raylet.pyx:252``
+# ObjectRefGenerator)
+GEN_ITEM = 58           # worker -> node: (task_id, index, ObjectMeta)
+GEN_ACK = 59            # node -> worker push: (task_id, consumed_count)
+GEN_NEXT = 60           # (req_id, task_id, index) -> INFO_REPLY
+                        #   ("item", meta) | ("end", count)
+                        #   | ("error", err_bytes)
+GEN_CLOSE = 61          # (task_id,) — consumer dropped the generator
+
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
 GET_REPLY = 41          # (req_id, [ObjectMeta])

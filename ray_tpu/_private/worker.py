@@ -232,6 +232,9 @@ class WorkerRuntime:
     # -------------------------------------------------------------- returns
     def _send_done(self, spec: P.TaskSpec, kind: str, result: Any,
                    exc: Optional[BaseException]) -> None:
+        if spec.num_returns == -1 and exc is None:
+            self._stream_returns(spec, kind, result)
+            return
         metas: List[ObjectMeta] = []
         err_bytes: Optional[bytes] = None
         if exc is not None:
@@ -268,9 +271,61 @@ class WorkerRuntime:
         # buffered nested submissions likewise precede our DONE
         self.client.flush_submissions()
         self.client.flush_refs()
-        self.conn.send((P.TASK_DONE, (spec.task_id, metas, err_bytes, kind)))
+        # a STREAMING task that failed before iteration started (arg
+        # load, actor method raising before returning a generator) must
+        # still end its stream — gen_count=0 + the error — or consumers
+        # parked on item 0 hang forever
+        gen_count = 0 if spec.num_returns == -1 else None
+        self.conn.send((P.TASK_DONE,
+                        (spec.task_id, metas, err_bytes, kind, gen_count)))
         # unconditional: force-traced spans exist even when THIS node's
         # config has tracing off (flush is a no-op on an empty buffer)
+        from ..util import tracing
+        tracing.flush()
+
+    def _stream_returns(self, spec: P.TaskSpec, kind: str,
+                        result: Any) -> None:
+        """Drive a streaming (num_returns=\"streaming\") task: store and
+        report each yielded item as it is produced, pacing against the
+        consumer with a bounded in-flight window (reference:
+        ReportGeneratorItemReturns, ``core_worker.proto:396``)."""
+        window = CONFIG.generator_backpressure_window
+        produced = 0
+        self.client.gen_credit_init(spec.task_id)
+        err: Optional[BaseException] = None
+        try:
+            it = iter(result)
+        except TypeError:
+            err = exceptions.TaskError(
+                "TypeError",
+                f"streaming task {spec.name} must return an iterable/"
+                f"generator, got {type(result).__name__}", "",
+                task_name=spec.name)
+            it = iter(())
+        while err is None:
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            except BaseException as e:  # noqa: BLE001 — reported to owner
+                err = e if isinstance(e, exceptions.RayTpuError) else \
+                    exceptions.TaskError(
+                        type(e).__name__, str(e),
+                        "".join(traceback.format_exception(
+                            type(e), e, e.__traceback__)),
+                        task_name=spec.name)
+                break
+            oid = ObjectID.for_gen_item(spec.task_id, produced)
+            meta = self._store_return(oid, item)
+            self.conn.send((P.GEN_ITEM, (spec.task_id, produced, meta)))
+            produced += 1
+            self.client.gen_wait_credit(spec.task_id, produced, window)
+        self.client.gen_credit_drop(spec.task_id)
+        err_bytes = ser.to_bytes(err) if err is not None else None
+        self.client.flush_submissions()
+        self.client.flush_refs()
+        self.conn.send((P.TASK_DONE,
+                        (spec.task_id, [], err_bytes, kind, produced)))
         from ..util import tracing
         tracing.flush()
 

@@ -28,6 +28,14 @@ _DEFAULT_TASK_CPUS = 1.0
 _DEFAULT_ACTOR_CPUS = 0.0
 
 
+def _norm_num_returns(n) -> int:
+    """\"streaming\"/\"dynamic\" -> -1 (dynamic returns via
+    ObjectRefGenerator; reference: ``num_returns=\"streaming\"``)."""
+    if n in ("streaming", "dynamic"):
+        return -1
+    return int(n)
+
+
 def _build_resources(opts: Dict[str, Any], default_cpus: float) -> Dict[str, float]:
     res: Dict[str, float] = {}
     num_cpus = opts.get("num_cpus")
@@ -101,7 +109,7 @@ class RemoteFunction:
         client = context.require_client()
         fid = self._ensure_exported(client)
         opts = self._options
-        num_returns = opts.get("num_returns", 1)
+        num_returns = _norm_num_returns(opts.get("num_returns", 1))
         refs = client.submit_task(
             function_id=fid,
             name=self._name,
@@ -113,6 +121,8 @@ class RemoteFunction:
             scheduling_strategy=opts.get("scheduling_strategy"),
             retry_exceptions=opts.get("retry_exceptions", False),
             runtime_env=_resolve_runtime_env(opts, client))
+        if num_returns == -1:
+            return refs                 # ObjectRefGenerator
         if num_returns == 1:
             return refs[0]
         return refs
@@ -181,7 +191,7 @@ class ActorMethod:
         # precedence: .options() > @method defaults on the class
         opts = {**self._handle._method_opts.get(self._method_name, {}),
                 **getattr(self, "_opts", {})}
-        num_returns = opts.get("num_returns", 1)
+        num_returns = _norm_num_returns(opts.get("num_returns", 1))
         refs = client.submit_actor_task(
             actor_id=self._handle._actor_id,
             method_name=self._method_name,
@@ -189,6 +199,8 @@ class ActorMethod:
             num_returns=num_returns,
             seq_no=self._handle._next_seq(),
             name=f"{self._handle._class_name}.{self._method_name}")
+        if num_returns == -1:
+            return refs                 # ObjectRefGenerator
         if num_returns == 1:
             return refs[0]
         return refs

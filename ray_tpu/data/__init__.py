@@ -12,6 +12,7 @@ onto the chips.
 
 from .dataset import ActorPoolStrategy, Dataset  # noqa: F401
 from .read_api import (  # noqa: F401
+    from_generators,
     from_items,
     from_numpy,
     range,

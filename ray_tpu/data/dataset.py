@@ -91,6 +91,17 @@ def _run_block_task(source_fn: Optional[Callable], source_block,
 
 
 @remote
+def _run_gen_source(source_fn: Callable):
+    """Streaming source: the producer yields blocks one by one and each
+    leaves the task as soon as it is produced (num_returns=\"streaming\"
+    — a 1000-block read never materializes 1000 blocks in the worker).
+    Reference analogue: a read task streaming its output blocks through
+    ObjectRefGenerator."""
+    for blk in source_fn():
+        yield B.normalize_block(blk)
+
+
+@remote
 class _UDFActor:
     """One pool member: constructs the user's class once, maps blocks."""
 
@@ -126,16 +137,21 @@ class Dataset:
     def __init__(self,
                  sources: Optional[List[Callable[[], Block]]] = None,
                  block_refs: Optional[List[Any]] = None,
-                 stages: Optional[List[Stage]] = None):
+                 stages: Optional[List[Stage]] = None,
+                 source_streaming: bool = False):
         # exactly one of sources (unread) / block_refs (materialized input)
         self._sources = sources
         self._block_refs = block_refs
         self._stages = stages or []
+        # True: each source is a GENERATOR of blocks executed as a
+        # streaming task (see _run_gen_source) rather than one block
+        self._source_streaming = source_streaming
 
     # ------------------------------------------------------------ transforms
     def _with_stage(self, stage: Stage) -> "Dataset":
         return Dataset(self._sources, self._block_refs,
-                       self._stages + [stage])
+                       self._stages + [stage],
+                       source_streaming=self._source_streaming)
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     compute: Optional[ActorPoolStrategy] = None,
@@ -247,6 +263,28 @@ class Dataset:
         bounded in-flight window, pulled by the consumer. Total live
         blocks stay ~sum of operator windows no matter how large the
         dataset is; refs the consumer drops are freed by refcounting."""
+        if self._sources is not None and self._source_streaming:
+            # streaming sources: block refs arrive one by one while the
+            # producer task still runs; the generator's backpressure
+            # window paces the producer against this consumer
+            def gen_stream() -> Iterator[Any]:
+                # all producers submitted up front so they run in
+                # parallel (each paced by its own backpressure window);
+                # drained in source order
+                gens = [_run_gen_source.options(
+                    num_returns="streaming").remote(fn)
+                    for fn in self._sources]
+                for gen in gens:
+                    yield from gen
+            stream = gen_stream()
+            for seg_kind, payload in self._segments():
+                if seg_kind == "tasks":
+                    stream = self._task_operator(
+                        ((None, ref) for ref in stream), payload, window)
+                else:
+                    stream = self._actor_operator(stream, payload)
+            yield from stream
+            return
         inputs: List[Tuple[Optional[Callable], Any]]
         if self._sources is not None:
             inputs = [(fn, None) for fn in self._sources]

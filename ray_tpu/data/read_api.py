@@ -152,3 +152,27 @@ def read_parquet(paths: Union[str, Sequence[str]], *,
                                      table.to_pydict().values())}
 
     return Dataset(sources=[functools.partial(read_one, p) for p in files])
+
+
+def from_generators(generators: Sequence[Any]) -> Dataset:
+    """Dataset whose sources are block GENERATORS: each callable yields
+    blocks one at a time, and every block leaves the producing task the
+    moment it is yielded (``num_returns="streaming"``), so a source that
+    produces 1000 blocks never holds more than the backpressure window
+    in flight. Reference analogue: streaming read tasks reporting blocks
+    through ``ObjectRefGenerator`` (``_raylet.pyx:252``).
+
+    Example::
+
+        def read_shard(path):
+            def gen():
+                for chunk in open_chunks(path):
+                    yield chunk_to_block(chunk)
+            return gen
+
+        ds = ray_tpu.data.from_generators([read_shard(p) for p in paths])
+    """
+    gens = list(generators)
+    if not gens:
+        raise ValueError("from_generators needs at least one generator")
+    return Dataset(sources=gens, source_streaming=True)

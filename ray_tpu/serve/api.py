@@ -155,6 +155,15 @@ class _GatewayHandler:
             self._handles[name] = handle
         return handle.remote(arg).result(timeout=30.0)
 
+    def stream(self, name: str, arg):
+        """Iterator of item values from a streaming deployment handler
+        (generator)."""
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = get_deployment_handle(name)
+            self._handles[name] = handle
+        return handle.stream(arg)
+
 
 def start_http(host: str = "127.0.0.1", port: int = 8000) -> str:
     global _http_server
@@ -186,6 +195,41 @@ def start_http(host: str = "127.0.0.1", port: int = 8000) -> str:
                     q = {k: v[0] if len(v) == 1 else v
                          for k, v in parse_qs(query).items()}
                     arg = q or None
+                if self.headers.get("X-RTPU-Stream"):
+                    # streaming response: one JSON line per produced
+                    # item, written (and flushed) as each arrives —
+                    # the client reads incrementally until EOF
+                    # (reference: Serve StreamingResponse,
+                    # ``_private/proxy.py`` ASGI streaming).
+                    # Pull the FIRST item before committing the 200 so
+                    # an immediately-failing handler gets a real 500;
+                    # later errors become a terminal {"error": ...}
+                    # line (headers are already on the wire by then).
+                    stream_it = iter(gateway.stream(name, arg))
+                    first = _STREAM_END = object()
+                    try:
+                        first = next(stream_it)
+                    except StopIteration:
+                        pass
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+
+                    def write_line(obj) -> None:
+                        self.wfile.write(
+                            (json.dumps(obj) + "\n").encode())
+                        self.wfile.flush()
+
+                    try:
+                        if first is not _STREAM_END:
+                            write_line({"item": first})
+                            for item in stream_it:
+                                write_line({"item": item})
+                    except Exception as e:  # noqa: BLE001 — terminal line
+                        write_line({"error": str(e)})
+                    return None
                 result = gateway.call(name, arg)
                 return self._json(200, {"result": result})
             except Exception as e:   # noqa: BLE001 — always answer JSON

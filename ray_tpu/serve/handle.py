@@ -80,8 +80,58 @@ class DeploymentHandle:
             return _TrackedRef(ref, self, idx)
         raise RuntimeError("no live replica accepted the request")
 
+    def stream(self, *args, **kwargs):
+        """Route one STREAMING request: the deployment's handler must
+        return a generator, whose items arrive as they are produced
+        (reference: Serve streaming responses over ObjectRefGenerator).
+        Returns an iterator of item VALUES."""
+        self._refresh()
+        for attempt in range(3):
+            idx = self._pick()
+            with self._lock:
+                replica = self._replicas[idx]
+            try:
+                gen = replica.handle_request.options(
+                    num_returns="streaming").remote(*args, **kwargs)
+            except Exception:
+                self._done(idx)
+                self._refresh(force=True)
+                continue
+            return _TrackedStream(gen, self, idx)
+        raise RuntimeError("no live replica accepted the request")
+
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self._controller))
+
+
+class _TrackedStream:
+    """Iterates a streaming response's values; releases the replica's
+    in-flight slot when the stream ends (or is dropped)."""
+
+    def __init__(self, gen, handle: "DeploymentHandle", idx: int):
+        self._gen = gen
+        self._handle = handle
+        self._idx = idx
+        self._released = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._gen)
+        except BaseException:
+            self._release()
+            raise
+        return get(ref)
+
+    def _release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._handle._done(self._idx)
+
+    def __del__(self):
+        self._release()
 
 
 class _TrackedRef:

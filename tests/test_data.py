@@ -198,3 +198,46 @@ def test_actor_pool_materialize(rtpu_init):
            .materialize())
     rows = mat.take_all()
     assert sorted(r["id"] for r in rows) == list(range(1, 81))
+
+
+def test_from_generators_streams_blocks(rtpu_init):
+    """A single producer yielding many blocks: the first block must be
+    consumable while the producer still runs, and residency stays
+    bounded by the generator backpressure window."""
+    import time as _time
+
+    def slow_producer():
+        def gen():
+            for i in range(12):
+                _time.sleep(0.15)
+                yield {"x": np.full(10, i, dtype=np.int64)}
+        return gen
+
+    ds = rd.from_generators([slow_producer()])
+    t0 = _time.time()
+    it = ds.iter_blocks()
+    first = next(it)
+    t_first = _time.time() - t0
+    assert first["x"][0] == 0
+    rest = list(it)
+    t_total = _time.time() - t0
+    assert len(rest) == 11
+    assert rest[-1]["x"][0] == 11
+    # streaming property, load-robust: the first block arrived well
+    # before the full 12x0.15s production run completed
+    assert t_first < 0.6 * t_total, \
+        f"first block at {t_first:.2f}s of {t_total:.2f}s total"
+
+
+def test_from_generators_with_stages(rtpu_init):
+    def prod():
+        def gen():
+            for i in range(5):
+                yield {"x": np.arange(4, dtype=np.int64) + 4 * i}
+        return gen
+
+    ds = (rd.from_generators([prod(), prod()])
+          .map_batches(lambda b: {"x": b["x"] * 10}))
+    got = sorted(v for blk in ds.iter_blocks() for v in blk["x"])
+    expect = sorted(v * 10 for _ in range(2) for v in range(20))
+    assert got == expect

@@ -223,3 +223,51 @@ def test_stop_http_releases_port(serve_session):
     sock = s.socket()
     sock.bind(("127.0.0.1", port))
     sock.close()
+
+
+def test_streaming_handle(serve_session):
+    import time as _time
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, n):
+            for i in range(int(n)):
+                _time.sleep(0.2)
+                yield f"tok{i}"
+
+    h = serve.run(Tokens.bind())
+    t0 = _time.time()
+    times = []
+    vals = []
+    for v in h.stream(6):
+        vals.append(v)
+        times.append(_time.time() - t0)
+    assert vals == [f"tok{i}" for i in range(6)]
+    # items arrived incrementally, not as one batch at the end
+    assert times[0] < 0.7 * times[-1], times
+
+
+def test_streaming_http(serve_session):
+    import time as _time
+    import urllib.request
+
+    @serve.deployment
+    class Chunks:
+        def __call__(self, arg):
+            for i in range(5):
+                _time.sleep(0.2)
+                yield {"i": i}
+
+    serve.run(Chunks.bind())
+    url = serve.start_http(port=0)
+    req = urllib.request.Request(f"{url}/Chunks", method="GET",
+                                 headers={"X-RTPU-Stream": "1"})
+    t0 = _time.time()
+    lines, stamps = [], []
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers["Content-Type"] == "application/x-ndjson"
+        for raw in resp:
+            lines.append(json.loads(raw))
+            stamps.append(_time.time() - t0)
+    assert [ln["item"]["i"] for ln in lines] == list(range(5))
+    assert stamps[0] < 0.7 * stamps[-1], stamps
