@@ -157,6 +157,9 @@ class GlobalControlPlane:
         self.directory: Dict[ObjectID, Tuple[NodeID, ObjectMeta]] = {}
         # streaming-return counters per producing task (see gen_update)
         self.gen_streams: Dict[TaskID, dict] = {}
+        # unplaceable placement groups awaiting capacity (autoscaler
+        # input; see register_pending_pg)
+        self.pending_pgs: Dict[PlacementGroupID, dict] = {}
         self.task_events: deque = deque(maxlen=CONFIG.task_events_buffer_size)
         self.cluster_events: deque = deque(
             maxlen=CONFIG.cluster_events_buffer_size)
@@ -458,6 +461,41 @@ class GlobalControlPlane:
     def drop_location(self, object_id: ObjectID) -> None:
         with self._lock:
             self.directory.pop(object_id, None)
+
+    # ------------------------------------------------- pending gangs
+    # Placement groups that could not be packed onto the live cluster.
+    # The client retries create_pg while blocked in ready(); these
+    # records make that demand visible to the autoscaler, which is THE
+    # scaling driver for gang workloads on TPU (reference:
+    # ``resource_demand_scheduler.py:102`` feeds pending placement
+    # groups into scale-up). last_attempt is refreshed per retry so a
+    # vanished driver's gang stops driving scale-up (staleness filter).
+
+    # purge records this long after their last retry: abandoned gangs
+    # (ready() timeout, dead driver) must not leak for the cluster's
+    # lifetime. Well past the autoscaler's 5s staleness bar.
+    PENDING_PG_TTL_S = 60.0
+
+    def register_pending_pg(self, spec) -> None:
+        with self._lock:
+            self._purge_stale_pending_pgs()
+            self.pending_pgs[spec.pg_id] = {"spec": spec,
+                                            "last_attempt": time.time()}
+
+    def clear_pending_pg(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            self.pending_pgs.pop(pg_id, None)
+
+    def pending_pgs_snapshot(self) -> List[dict]:
+        with self._lock:
+            self._purge_stale_pending_pgs()
+            return [dict(rec) for rec in self.pending_pgs.values()]
+
+    def _purge_stale_pending_pgs(self) -> None:
+        cutoff = time.time() - self.PENDING_PG_TTL_S
+        for pg_id in [p for p, rec in self.pending_pgs.items()
+                      if rec["last_attempt"] < cutoff]:
+            del self.pending_pgs[pg_id]
 
     # ------------------------------------------------- generator streams
     # Streaming-return bookkeeping (reference: the owner-side generator

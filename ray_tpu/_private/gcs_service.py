@@ -42,6 +42,7 @@ _ALLOWED = frozenset({
     "record_spans", "list_spans", "claim_actor_reroute",
     "requeue_actor_reroute",
     "gen_update", "gen_done", "gen_consumed", "gen_get", "gen_drop",
+    "register_pending_pg", "clear_pending_pg", "pending_pgs_snapshot",
 })
 
 
@@ -199,6 +200,7 @@ class RemoteControlPlane:
         "unpin_task_args", "record_lineage",
         "record_cluster_event", "record_spans",
         "gen_update", "gen_done", "gen_consumed", "gen_drop",
+        "register_pending_pg", "clear_pending_pg",
     })
 
     def __init__(self, address: str):

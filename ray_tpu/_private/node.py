@@ -2898,6 +2898,9 @@ class NodeService:
         assignment = sched.pack_bundles(spec.bundles, spec.strategy,
                                         self._candidates())
         if assignment is None:
+            # make the gang demand visible to the autoscaler; refreshed
+            # on every client retry, cleared on success/removal
+            self.gcs.register_pending_pg(spec)
             self._reply(conn_key, P.INFO_REPLY, (req_id, None))
             return
         ok = True
@@ -2915,9 +2918,11 @@ class NodeService:
             self._reply(conn_key, P.INFO_REPLY, (req_id, None))
             return
         self.gcs.register_pg(spec, assignment)
+        self.gcs.clear_pending_pg(spec.pg_id)
         self._reply(conn_key, P.INFO_REPLY, (req_id, assignment))
 
     def _remove_pg(self, pg_id) -> None:
+        self.gcs.clear_pending_pg(pg_id)
         rec = self.gcs.remove_pg(pg_id)
         if rec is None:
             return

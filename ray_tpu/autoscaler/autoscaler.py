@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclass
@@ -78,17 +78,37 @@ class StandardAutoscaler:
                 import traceback
                 traceback.print_exc(file=sys.stderr)
 
+    # max age of a pending-PG record before it stops driving scale-up
+    # (the blocked client refreshes it every <=0.5s; older means the
+    # driver gave up or died)
+    PENDING_PG_STALE_S = 5.0
+
     # --------------------------------------------------------------- update
     def update(self) -> None:
         nodes = [n for n in self.gcs.alive_nodes()]
         demand: List[Dict[str, float]] = []
         for n in nodes:
             demand.extend(n.pending_shapes)
-        self._scale_up(nodes, demand)
-        self._scale_down(nodes, demand)
+        gangs = self._pending_gangs()
+        self._scale_up(nodes, demand, gangs)
+        self._scale_down(nodes, demand or gangs)
 
-    def _scale_up(self, nodes, demand: List[Dict[str, float]]) -> None:
-        if not demand:
+    def _pending_gangs(self) -> List[Any]:
+        """Fresh unplaceable placement groups (reference:
+        ``resource_demand_scheduler.py:102`` — pending PGs feed
+        scale-up; on TPU, gangs are THE autoscaling driver)."""
+        try:
+            recs = self.gcs.pending_pgs_snapshot()
+        except Exception:
+            return []
+        now = time.time()
+        return [r["spec"] for r in recs
+                if now - r["last_attempt"] < self.PENDING_PG_STALE_S]
+
+    def _scale_up(self, nodes,
+                  demand: List[Dict[str, float]],
+                  gangs: Optional[List[Any]] = None) -> None:
+        if not demand and not gangs:
             return
         # shapes the live cluster will absorb on its own don't count
         avail = [dict(n.resources_available or n.resources_total)
@@ -105,22 +125,13 @@ class StandardAutoscaler:
                     break
             if not placed:
                 unmet.append(shape)
-        if not unmet:
-            return
 
         counts = self._count_by_type()
         # first-fit decreasing over open bins of configured node types
         bins: List[tuple] = []                     # (type_name, remaining)
         to_launch: Dict[str, int] = {}
-        for shape in sorted(unmet, key=lambda s: -sum(s.values())):
-            placed = False
-            for _, remaining in bins:
-                if _fits(remaining, shape):
-                    _subtract(remaining, shape)
-                    placed = True
-                    break
-            if placed:
-                continue
+
+        def open_bin(shape) -> bool:
             for tname, ntype in self.config.node_types.items():
                 live = counts.get(tname, 0) + to_launch.get(tname, 0)
                 if live >= ntype.max_workers:
@@ -130,9 +141,27 @@ class StandardAutoscaler:
                     _subtract(remaining, shape)
                     bins.append((tname, remaining))
                     to_launch[tname] = to_launch.get(tname, 0) + 1
+                    return True
+            return False
+
+        for shape in sorted(unmet, key=lambda s: -sum(s.values())):
+            placed = False
+            for _, remaining in bins:
+                if _fits(remaining, shape):
+                    _subtract(remaining, shape)
+                    placed = True
                     break
+            if not placed:
+                open_bin(shape)
             # no type fits the shape: it stays unmet (the task will fail
             # at its grace deadline with a clear error)
+
+        # Gangs: pack WHOLE placement groups, honoring their strategy —
+        # partial capacity is useless to a gang, so the nodes it needs
+        # are planned together (atomic scale-up; the launch-cap below
+        # still rate-limits the provider calls per update).
+        for spec in gangs or []:
+            self._plan_gang(spec, avail, bins, counts, to_launch, open_bin)
 
         cap = max(1, int(self.config.upscaling_speed * max(1, len(nodes))))
         budget = cap
@@ -145,6 +174,65 @@ class StandardAutoscaler:
                     tname, ntype.resources,
                     labels={"rtpu.io/autoscaled": "1"})
                 self.num_launched += 1
+
+    def _plan_gang(self, spec, avail, bins, counts, to_launch,
+                   open_bin) -> None:
+        """Plan nodes for one unplaceable placement group.
+
+        STRICT_PACK: all bundles on ONE node — a single new node fitting
+        their sum. STRICT_SPREAD: each bundle on a DISTINCT node — one
+        new node per bundle not absorbable by a distinct live node.
+        PACK/SPREAD: best-effort — bundles bin-packed like plain shapes.
+        Reference: ``resource_demand_scheduler.py:102`` +
+        ``bundle_scheduling_policy.cc`` strategy semantics.
+        """
+        bundles = list(spec.bundles)
+        if spec.strategy == "STRICT_PACK":
+            total: Dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            for a in avail:
+                if _fits(a, total):
+                    _subtract(a, total)     # live capacity will absorb it
+                    return
+            open_bin(total)
+            return
+        if spec.strategy == "STRICT_SPREAD":
+            # greedily absorb bundles onto DISTINCT live nodes; a node
+            # may host at most one bundle of this gang
+            used = set()
+            remaining = []
+            for b in bundles:
+                for i, a in enumerate(avail):
+                    if i not in used and _fits(a, b):
+                        _subtract(a, b)
+                        used.add(i)
+                        break
+                else:
+                    remaining.append(b)
+            # one FRESH node per leftover bundle (bins opened by other
+            # demand must not double-host two bundles of this gang)
+            for b in remaining:
+                open_bin(b)
+            return
+        # PACK / SPREAD: best-effort placement, plain bin-packing
+        for b in bundles:
+            placed = False
+            for a in avail:
+                if _fits(a, b):
+                    _subtract(a, b)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for _, remaining_bin in bins:
+                if _fits(remaining_bin, b):
+                    _subtract(remaining_bin, b)
+                    placed = True
+                    break
+            if not placed:
+                open_bin(b)
 
     def _scale_down(self, nodes, demand: List[Dict[str, float]]) -> None:
         if demand:

@@ -68,6 +68,11 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     # training
     remat: bool = True
+    # what the per-block checkpoint saves for backward: "full" recomputes
+    # everything (lowest memory, ~4/3x flops); "dots" saves matmul
+    # outputs and recomputes only cheap elementwise ops (the usual MFU
+    # sweet spot when HBM allows)
+    remat_policy: str = "full"
     z_loss: float = 1e-4
     # attention kernel: "auto" | "pallas" | "pallas_interpret" | "reference"
     attention_impl: str = "auto"
@@ -434,15 +439,38 @@ class GPT:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1], dtype=jnp.int32),
                 tokens.shape)
-        x = params["tok_embed"].astype(c.dtype)[tokens]
+        # Embedding lookup with an EXPLICIT all-gather of the
+        # (vocab/tp, embed/fsdp)-sharded table and (batch, seq)-sharded
+        # indices: left to inference, the partitioner shards the gather
+        # output on tp and then falls back to "involuntary full
+        # rematerialization" resharding it to (batch, seq) — the
+        # spmd_partitioner.cc warning in MULTICHIP_r03. Replicated
+        # operand + sharded indices computes the gather directly in the
+        # activation sharding.
+        tbl = self._constrain(params["tok_embed"].astype(c.dtype),
+                              None, None)
+        tokens = self._constrain(tokens, "act_batch", "act_seq")
+        x = tbl[tokens]
         if c.positions == "learned":
-            x = x + params["pos_embed"].astype(c.dtype)[positions]
+            pos_tbl = self._constrain(params["pos_embed"].astype(c.dtype),
+                                      None, None)
+            x = x + pos_tbl[positions]
         x = self._constrain(x, "act_batch", "act_seq", "act_embed")
 
         block_fn = self._block
         if c.remat:
-            block_fn = jax.checkpoint(
-                block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+            policies = {
+                "full": jax.checkpoint_policies.nothing_saveable,
+                "dots":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }
+            if c.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy must be one of {sorted(policies)}, "
+                    f"got {c.remat_policy!r} (use remat=False to disable "
+                    "rematerialization entirely)")
+            block_fn = jax.checkpoint(block_fn,
+                                      policy=policies[c.remat_policy])
 
         if self.pp_stages > 1:
             x = self._pipeline_blocks(block_fn, params["blocks"], x,

@@ -96,3 +96,126 @@ def test_min_workers_kept_warm(autoscaling_cluster):
     time.sleep(scaler.config.idle_timeout_s + 2.0)
     # idle well past the timeout, but min_workers floors the pool
     assert len(provider.non_terminated_nodes()) == 1
+
+
+def _wait(pred, timeout=45.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_strict_spread_pg_scales_up_n_nodes(autoscaling_cluster):
+    """A pending STRICT_SPREAD gang that fits no current node must
+    launch one node PER BUNDLE (reference:
+    ``resource_demand_scheduler.py:102`` pending-PG demand)."""
+    cluster, provider, scaler = autoscaling_cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    # head has no TPU: 3 distinct TPU nodes are needed
+    pg = placement_group([{"TPU": 2.0}] * 3, strategy="STRICT_SPREAD")
+    pg.ready(timeout=90)
+    nodes = provider.non_terminated_nodes()
+    assert len(nodes) == 3, f"expected 3 gang nodes, got {len(nodes)}"
+    # bundles landed on distinct nodes
+    assignment = pg._assignment
+    assert len({nid for nid in assignment}) == 3
+    remove_placement_group(pg)
+
+
+def test_strict_pack_pg_scales_up_one_node(autoscaling_cluster):
+    cluster, provider, scaler = autoscaling_cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    # sum of bundles fits ONE tpu_worker (4 TPU): one launch, not two
+    pg = placement_group([{"TPU": 2.0}, {"TPU": 2.0}],
+                         strategy="STRICT_PACK")
+    pg.ready(timeout=90)
+    assert len(provider.non_terminated_nodes()) == 1
+    assert len({nid for nid in pg._assignment}) == 1
+    remove_placement_group(pg)
+
+
+def test_pack_pg_best_effort_scales(autoscaling_cluster):
+    cluster, provider, scaler = autoscaling_cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    # 6 TPU total > one 4-TPU worker: PACK may span nodes; needs 2
+    pg = placement_group([{"TPU": 3.0}, {"TPU": 3.0}], strategy="PACK")
+    pg.ready(timeout=90)
+    assert len(provider.non_terminated_nodes()) == 2
+    remove_placement_group(pg)
+
+
+def test_satisfied_pg_stops_driving_scaleup(autoscaling_cluster):
+    """Once the gang reserves, its pending record is cleared: no extra
+    nodes keep launching."""
+    cluster, provider, scaler = autoscaling_cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    pg = placement_group([{"TPU": 1.0}], strategy="PACK")
+    pg.ready(timeout=90)
+    launched = scaler.num_launched
+    time.sleep(3 * scaler.config.update_interval_s + 0.5)
+    assert scaler.num_launched == launched, "kept scaling for a placed PG"
+    assert not cluster.gcs.pending_pgs_snapshot()
+    remove_placement_group(pg)
+
+
+def test_stale_pending_pg_ignored(autoscaling_cluster):
+    """A pending record whose driver stopped retrying must not drive
+    scale-up (the record goes stale)."""
+    cluster, provider, scaler = autoscaling_cluster
+    from ray_tpu._private import protocol as P
+    from ray_tpu._private.ids import PlacementGroupID
+
+    spec = P.PlacementGroupSpec(pg_id=PlacementGroupID.from_random(),
+                                bundles=[{"TPU": 2.0}], strategy="PACK")
+    cluster.gcs.register_pending_pg(spec)
+    # age it past the staleness bar without refreshing
+    rec = cluster.gcs.pending_pgs[spec.pg_id]
+    rec["last_attempt"] -= scaler.PENDING_PG_STALE_S + 1
+    before = scaler.num_launched
+    time.sleep(3 * scaler.config.update_interval_s + 0.5)
+    assert scaler.num_launched == before, "stale gang drove scale-up"
+
+
+def test_pending_pg_blocks_idle_drain(autoscaling_cluster):
+    """Capacity is kept while a fresh gang is pending, even if current
+    nodes are idle (the gang may be waiting on the LAST node)."""
+    cluster, provider, scaler = autoscaling_cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    # place a 1-bundle PG to get one node up, then keep a second,
+    # unsatisfiable gang pending: the idle node must NOT drain
+    pg = placement_group([{"TPU": 4.0}], strategy="PACK")
+    pg.ready(timeout=90)
+    assert len(provider.non_terminated_nodes()) == 1
+    remove_placement_group(pg)     # node now fully idle
+
+    scaler.config.node_types["tpu_worker"].max_workers = 1  # pin fleet
+    import threading
+    big = placement_group([{"TPU": 4.0}] * 3, strategy="STRICT_SPREAD")
+    stop = threading.Event()
+
+    def keep_retrying():
+        while not stop.is_set():
+            big._try_create()
+            time.sleep(0.3)
+
+    t = threading.Thread(target=keep_retrying, daemon=True)
+    t.start()
+    try:
+        time.sleep(scaler.config.idle_timeout_s + 2.0)
+        assert len(provider.non_terminated_nodes()) == 1, \
+            "idle node drained while a gang was pending"
+    finally:
+        stop.set()
+        t.join()
