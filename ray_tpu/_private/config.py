@@ -41,6 +41,12 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                     "resources stay debited from the router's "
                                     "view of the target node (bridges heartbeat "
                                     "staleness so bursts don't pile onto one node)"),
+    "ref_zero_grace_ms": (int, 50,
+                          "delay between an object's refcount reaching zero "
+                          "and its free, absorbing in-flight borrower "
+                          "registrations (a ref passed through a queue actor "
+                          "briefly reads as zero between the sender's drop "
+                          "and the receiver's register)"),
     "generator_backpressure_window": (int, 16,
                                       "max unconsumed streaming-generator items "
                                       "in flight before the producer blocks "

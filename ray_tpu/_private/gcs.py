@@ -176,6 +176,9 @@ class GlobalControlPlane:
         # returns whose refs all died BEFORE the task sealed them: the
         # seal must free them immediately (fire-and-forget tasks)
         self._freed_early: set = set()
+        # zero-count objects in their free-grace window (oid -> deadline;
+        # see _schedule_zero_locked)
+        self._zero_pending: Dict[ObjectID, float] = {}
         # lineage: creating TaskSpec per return object, for reconstruction
         # (reference: object_recovery_manager.h:90), bounded by
         # CONFIG.max_lineage_bytes
@@ -250,7 +253,6 @@ class GlobalControlPlane:
     def remove_node(self, node_id: NodeID, reason: str = "") -> None:
         dead_actors: List[ActorID] = []
         restart_actors: List[ActorID] = []
-        freed: List[Any] = []
         with self._lock:
             info = self.nodes.get(node_id)
             if info is None:
@@ -280,11 +282,12 @@ class GlobalControlPlane:
             orphans = [tid for tid, owner in self._task_pin_owner.items()
                        if owner == node_id]
             for tid in orphans:
-                self._unpin_locked(tid, freed)
+                self._unpin_locked(tid)
         self.publish("NODE", {"node_id": node_id, "state": "DEAD",
                               "reason": reason})
-        for z in freed:
-            self.publish("REF_ZERO", z)
+        # drain the released pins even if no further ref edges arrive
+        # (e.g. the cluster just collapsed to its last node)
+        self.sweep_ref_zeros()
         for aid in restart_actors:
             self.publish("ACTOR", {"actor_id": aid,
                                    "state": ACTOR_RESTARTING,
@@ -322,6 +325,9 @@ class GlobalControlPlane:
                     info.resources_available = resources_available
                 if pending_shapes is not None:
                     info.pending_shapes = pending_shapes
+        # heartbeats double as the grace sweeper so pending frees drain
+        # even when no further ref edges arrive
+        self.sweep_ref_zeros()
 
     def get_node(self, node_id: NodeID) -> Optional[NodeInfo]:
         with self._lock:
@@ -571,27 +577,59 @@ class GlobalControlPlane:
     def ref_register(self, oid: ObjectID, holder: tuple) -> None:
         with self._lock:
             self.ref_holders.setdefault(oid, set()).add(holder)
+            # a borrow landed during the zero-grace window: cancel the
+            # pending free (see _schedule_zero_locked)
+            self._zero_pending.pop(oid, None)
 
     def ref_drop(self, oid: ObjectID, holder: tuple) -> None:
-        free = None
         with self._lock:
             holders = self.ref_holders.get(oid)
             if holders is None:
                 return   # never tracked (or already freed): not ours
             holders.discard(holder)
-            free = self._zero_check(oid)
-        if free is not None:
-            self.publish("REF_ZERO", free)
+            self._schedule_zero_locked(oid)
+        self.sweep_ref_zeros()
 
     def drop_all_refs(self, holder: tuple, oids: List[ObjectID]) -> None:
         """A holder process died/disconnected: drop everything it held."""
-        freed = []
         with self._lock:
             for oid in oids:
                 holders = self.ref_holders.get(oid)
                 if holders is None:
                     continue
                 holders.discard(holder)
+                self._schedule_zero_locked(oid)
+        self.sweep_ref_zeros()
+
+    def _schedule_zero_locked(self, oid: ObjectID) -> None:
+        """Count hit zero: schedule the free after a short grace window
+        instead of freeing now. A ref travelling between processes (a
+        queue actor returns [ref] and drops its copy while the consumer's
+        REGISTER is still in flight) briefly reads as zero; freeing
+        immediately would vaporize the object under the borrower.
+        Reference analogue: the owner-hosted borrower protocol
+        (WaitForRefRemoved, ``reference_count.h:61``) — the centralized
+        design absorbs edge races with time instead of per-borrower
+        chains."""
+        holders = self.ref_holders.get(oid)
+        if holders is None or holders or self.ref_pins.get(oid, 0) > 0:
+            return
+        self._zero_pending.setdefault(
+            oid, time.time() + CONFIG.ref_zero_grace_ms / 1000.0)
+
+    def sweep_ref_zeros(self) -> None:
+        """Publish frees whose grace expired with the count still zero.
+        Called from the edge paths and from heartbeats (so zeros drain
+        even on an otherwise-idle cluster)."""
+        freed = []
+        now = time.time()
+        with self._lock:
+            if not self._zero_pending:
+                return
+            for oid, deadline in list(self._zero_pending.items()):
+                if deadline > now:
+                    continue
+                del self._zero_pending[oid]
                 z = self._zero_check(oid)
                 if z is not None:
                     freed.append(z)
@@ -612,13 +650,11 @@ class GlobalControlPlane:
                 self.ref_pins[oid] = self.ref_pins.get(oid, 0) + 1
 
     def unpin_task_args(self, task_id: TaskID) -> None:
-        freed = []
         with self._lock:
-            self._unpin_locked(task_id, freed)
-        for z in freed:
-            self.publish("REF_ZERO", z)
+            self._unpin_locked(task_id)
+        self.sweep_ref_zeros()
 
-    def _unpin_locked(self, task_id: TaskID, freed: list) -> None:
+    def _unpin_locked(self, task_id: TaskID) -> None:
         self._task_pin_owner.pop(task_id, None)
         for oid in self._task_arg_refs.pop(task_id, ()):
             n = self.ref_pins.get(oid, 1) - 1
@@ -626,9 +662,7 @@ class GlobalControlPlane:
                 self.ref_pins.pop(oid, None)
             else:
                 self.ref_pins[oid] = n
-            z = self._zero_check(oid)
-            if z is not None:
-                freed.append(z)
+            self._schedule_zero_locked(oid)
 
     def _zero_check(self, oid: ObjectID):
         """Callers hold _lock. Returns a REF_ZERO payload when the object

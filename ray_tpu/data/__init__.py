@@ -10,14 +10,32 @@ prefetch (``Dataset.iter_device_batches``) feeding jax arrays straight
 onto the chips.
 """
 
+from .block import BlockMetadata, block_metadata  # noqa: F401
 from .dataset import ActorPoolStrategy, Dataset  # noqa: F401
+from .iterator import DataIterator  # noqa: F401
 from .read_api import (  # noqa: F401
     from_generators,
     from_items,
     from_numpy,
     range,
     range_tensor,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
+    read_numpy,
     read_parquet,
+    read_text,
+    read_tfrecords,
+)
+from .datasource import (  # noqa: F401
+    BinaryDatasource,
+    CSVDatasource,
+    FileBasedDatasource,
+    ImageDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    TextDatasource,
+    TFRecordDatasource,
 )

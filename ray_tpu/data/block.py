@@ -8,6 +8,7 @@ zero-copies numpy.
 
 from __future__ import annotations
 
+from dataclasses import dataclass as _dataclass
 from typing import Any, Dict, Iterator, List, Sequence
 
 import numpy as np
@@ -68,3 +69,30 @@ def normalize_block(data: Any) -> Block:
             return block_from_rows(data)
         return {"data": np.asarray(data)}
     raise TypeError(f"cannot interpret {type(data)} as a block")
+
+
+@_dataclass
+class BlockMetadata:
+    """Size/shape/schema of one block (reference: ``BlockMetadata`` in
+    ``python/ray/data/block.py`` — num_rows/size_bytes/schema)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Dict[str, str]          # column -> "dtype shape-tail"
+
+
+def block_metadata(block: Block) -> BlockMetadata:
+    num_rows = block_num_rows(block)
+    size = 0
+    schema: Dict[str, str] = {}
+    for k, v in block.items():
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            size += sum(len(x) if isinstance(x, (bytes, str)) else 64
+                        for x in arr.ravel())
+            schema[k] = "object"
+        else:
+            size += arr.nbytes
+            tail = arr.shape[1:]
+            schema[k] = f"{arr.dtype}{list(tail) if tail else ''}"
+    return BlockMetadata(num_rows=num_rows, size_bytes=size, schema=schema)

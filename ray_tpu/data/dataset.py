@@ -91,6 +91,16 @@ def _run_block_task(source_fn: Optional[Callable], source_block,
 
 
 @remote
+def _count_block(blk: Block) -> int:
+    return B.block_num_rows(blk)
+
+
+@remote
+def _meta_block(blk: Block):
+    return B.block_metadata(blk)
+
+
+@remote
 def _run_gen_source(source_fn: Callable):
     """Streaming source: the producer yields blocks one by one and each
     leaves the task as soon as it is produced (num_returns=\"streaming\"
@@ -391,6 +401,55 @@ class Dataset:
     def iter_blocks(self) -> Iterator[Block]:
         for ref in self.streaming_block_refs():
             yield get(ref)
+
+    def streaming_split(self, n: int, *, queue_size: int = 4):
+        """n concurrently-consumable DataIterator shards (reference:
+        ``Dataset.streaming_split`` / Train ingest ``data_config.py``);
+        see ``data/iterator.py``."""
+        from .iterator import streaming_split
+        return streaming_split(self, n, queue_size=queue_size)
+
+    def schema(self) -> Dict[str, str]:
+        """Column -> dtype/shape of the first block (reference:
+        ``Dataset.schema``); consumes one block of the stream."""
+        for blk in self.iter_blocks():
+            if blk:
+                return B.block_metadata(blk).schema
+        return {}
+
+    def _windowed_apply(self, task_fn, window: int = 16) -> Iterator[Any]:
+        """Map every block ref through ``task_fn`` with a bounded
+        in-flight window, dropping each block ref as its result is
+        consumed — aggregate queries must not defeat the streaming
+        executor's residency bound by holding every ref at once."""
+        in_flight: "deque" = deque()
+        for ref in self.streaming_block_refs():
+            in_flight.append(task_fn.remote(ref))
+            del ref
+            if len(in_flight) >= window:
+                yield get(in_flight.popleft())
+        while in_flight:
+            yield get(in_flight.popleft())
+
+    def count(self) -> int:
+        """Total rows; counted block-by-block in remote tasks so the
+        payloads never concentrate on the driver."""
+        return int(sum(self._windowed_apply(_count_block)))
+
+    def stats(self) -> Dict[str, Any]:
+        """num_blocks / num_rows / size_bytes, metadata computed
+        block-by-block in remote tasks (reference: BlockMetadata
+        aggregation)."""
+        n_blocks = n_rows = n_bytes = 0
+        schema: Dict[str, str] = {}
+        for m in self._windowed_apply(_meta_block):
+            if not n_blocks:
+                schema = m.schema
+            n_blocks += 1
+            n_rows += m.num_rows
+            n_bytes += m.size_bytes
+        return {"num_blocks": n_blocks, "num_rows": n_rows,
+                "size_bytes": n_bytes, "schema": schema}
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for blk in self.iter_blocks():

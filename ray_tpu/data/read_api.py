@@ -8,32 +8,12 @@ from __future__ import annotations
 
 import builtins
 import functools
-import glob as _glob
-import os
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .block import Block, block_from_rows, normalize_block
+from .block import block_from_rows, normalize_block
 from .dataset import Dataset
-
-
-def _expand_paths(paths: Union[str, Sequence[str]],
-                  suffix: Optional[str] = None) -> List[str]:
-    if isinstance(paths, str):
-        paths = [paths]
-    out: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            pat = os.path.join(p, f"*{suffix}" if suffix else "*")
-            out.extend(sorted(_glob.glob(pat)))
-        elif any(ch in p for ch in "*?["):
-            out.extend(sorted(_glob.glob(p)))
-        else:
-            out.append(p)
-    if not out:
-        raise FileNotFoundError(f"no files matched {paths}")
-    return out
 
 
 def range(n: int, *, num_blocks: Optional[int] = None) -> Dataset:
@@ -90,68 +70,61 @@ def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]],
                             for lo, hi in zip(bounds[:-1], bounds[1:])])
 
 
+def _from_datasource(ds) -> Dataset:
+    """Dataset over a FileBasedDatasource: one STREAMING read task per
+    file, yielding bounded-row blocks as the read progresses."""
+    return Dataset(sources=ds.sources(), source_streaming=True)
+
+
 def read_csv(paths: Union[str, Sequence[str]], **kw) -> Dataset:
-    files = _expand_paths(paths, ".csv")
-
-    def read_one(path: str) -> Block:
-        import csv
-        with open(path, newline="") as f:
-            rows = list(csv.DictReader(f))
-        blk = block_from_rows(rows)
-        # numeric columns parse as numbers (csv gives strings)
-        out = {}
-        for k, v in blk.items():
-            try:
-                out[k] = v.astype(np.int64)
-            except ValueError:
-                try:
-                    out[k] = v.astype(np.float64)
-                except ValueError:
-                    out[k] = v
-        return out
-
-    return Dataset(sources=[functools.partial(read_one, p) for p in files])
+    from .datasource import CSVDatasource
+    return _from_datasource(CSVDatasource(paths, **kw))
 
 
 def read_json(paths: Union[str, Sequence[str]], *, lines: bool = True,
               **kw) -> Dataset:
-    files = _expand_paths(paths, ".jsonl" if lines else ".json")
-
-    def read_one(path: str) -> Block:
-        import json
-        with open(path) as f:
-            if lines:
-                rows = [json.loads(line) for line in f if line.strip()]
-            else:
-                data = json.load(f)
-                rows = data if isinstance(data, list) else [data]
-        return block_from_rows(rows)
-
-    return Dataset(sources=[functools.partial(read_one, p) for p in files])
+    from .datasource import JSONDatasource
+    return _from_datasource(JSONDatasource(paths, lines=lines, **kw))
 
 
 def read_parquet(paths: Union[str, Sequence[str]], *,
                  columns: Optional[List[str]] = None, **kw) -> Dataset:
-    """Parquet via pyarrow if present, else torch-free fallback error.
+    """Parquet via pyarrow (gated so the core package has no hard
+    dependency); reads stream at row-group granularity."""
+    from .datasource import ParquetDatasource
+    return _from_datasource(ParquetDatasource(paths, columns=columns, **kw))
 
-    (pyarrow ships with the baked pandas/pyarrow stack when available;
-    gated so the core package has no hard dependency.)
-    """
-    files = _expand_paths(paths, ".parquet")
 
-    def read_one(path: str) -> Block:
-        try:
-            import pyarrow.parquet as pq
-        except ImportError as e:
-            raise ImportError(
-                "read_parquet requires pyarrow, which is not available "
-                "in this environment") from e
-        table = pq.read_table(path, columns=columns)
-        return {name: np.asarray(col)
-                for name, col in zip(table.column_names,
-                                     table.to_pydict().values())}
+def read_text(paths: Union[str, Sequence[str]], **kw) -> Dataset:
+    """One row per line: {"text": str} (reference:
+    ``data/read_api.py`` read_text -> text_datasource)."""
+    from .datasource import TextDatasource
+    return _from_datasource(TextDatasource(paths, **kw))
 
-    return Dataset(sources=[functools.partial(read_one, p) for p in files])
+
+def read_numpy(paths: Union[str, Sequence[str]], **kw) -> Dataset:
+    """.npy -> {"data": rows}; .npz -> one column per entry."""
+    from .datasource import NumpyDatasource
+    return _from_datasource(NumpyDatasource(paths, **kw))
+
+
+def read_binary_files(paths: Union[str, Sequence[str]], **kw) -> Dataset:
+    """One row per file: {"bytes", "path"}."""
+    from .datasource import BinaryDatasource
+    return _from_datasource(BinaryDatasource(paths, **kw))
+
+
+def read_images(paths: Union[str, Sequence[str]], **kw) -> Dataset:
+    """{"image": HWC array, "path"} rows via PIL (gated)."""
+    from .datasource import ImageDatasource
+    return _from_datasource(ImageDatasource(paths, **kw))
+
+
+def read_tfrecords(paths: Union[str, Sequence[str]], **kw) -> Dataset:
+    """tf.train.Example tfrecords, parsed without a tensorflow
+    dependency (see ``datasource.TFRecordDatasource``)."""
+    from .datasource import TFRecordDatasource
+    return _from_datasource(TFRecordDatasource(paths, **kw))
 
 
 def from_generators(generators: Sequence[Any]) -> Dataset:

@@ -21,3 +21,5 @@ from .learner import Learner, LearnerGroup  # noqa: F401
 from .module import DiscretePolicyModule  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .sample_batch import SampleBatch, concat_batches  # noqa: F401
+from .dqn import DQN, DQNConfig, ReplayBuffer  # noqa: F401
+from .module import QNetworkModule  # noqa: F401

@@ -1,0 +1,333 @@
+"""DQN: the minimal off-policy family member.
+
+Reference: ``rllib/algorithms/dqn/`` (replay buffer + target network +
+epsilon-greedy collection). TPU-native mapping:
+
+  * The REPLAY PLANE is the object store: rollout actors ``put`` each
+    collected fragment and register only the ObjectRef with the replay
+    buffer actor, so replay data lives in shm — the buffer actor holds
+    refs, never payloads (reference: replay buffers are actor-hosted,
+    ``rllib/utils/replay_buffers/``; here zero-copy via the store).
+  * The learner's update (double-DQN TD loss + optax step + periodic
+    target sync) is one jitted program.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import get, put, remote
+from . import sample_batch as SB
+from .module import QNetworkModule
+from .sample_batch import SampleBatch, concat_batches
+
+NEXT_OBS = "next_obs"
+
+
+@remote(num_cpus=0)
+class ReplayBuffer:
+    """Holds ObjectRefs of transition fragments (the payloads stay in
+    the object store); uniform sampling over stored fragments. Capacity
+    is in TRANSITIONS; oldest fragments are dropped (their store blocks
+    free via refcounting once unreferenced)."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self._capacity = capacity
+        self._frags: List[tuple] = []        # ([ref], n_transitions)
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, wrapped_ref, count: int) -> int:
+        self._frags.append((wrapped_ref, count))
+        self._size += count
+        while self._size - self._frags[0][1] >= self._capacity \
+                and len(self._frags) > 1:
+            _, n = self._frags.pop(0)
+            self._size -= n
+        return self._size
+
+    def size(self) -> int:
+        return self._size
+
+    def sample_refs(self, n_fragments: int) -> List[Any]:
+        """Random fragments (with replacement) — the learner fetches the
+        payloads itself, so replay bytes never route through this
+        actor."""
+        if not self._frags:
+            return []
+        idx = self._rng.integers(0, len(self._frags), size=n_fragments)
+        return [self._frags[i][0] for i in idx]
+
+
+@remote(num_cpus=1)
+class _DQNRolloutWorker:
+    """Epsilon-greedy collection of (obs, action, reward, next_obs,
+    done) transitions; fragments go straight into the object store."""
+
+    def __init__(self, env_creator: Callable, module_config: dict,
+                 seed: int = 0):
+        import jax
+
+        self.env = env_creator()
+        self.module = QNetworkModule(**module_config)
+        self._rng = np.random.default_rng(seed)
+        self._obs = None
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+        def _q_impl(params, obs):
+            return self.module.forward(params, obs)
+
+        self._q = jax.jit(_q_impl)
+
+    def collect(self, weights, num_steps: int, epsilon: float):
+        """Returns (wrapped fragment ref, count, stats): the fragment is
+        ``put`` here so the replay plane is the shm store."""
+        import jax
+
+        params = jax.tree_util.tree_map(jax.numpy.asarray, weights)
+        if self._obs is None:
+            self._obs, _ = self.env.reset()
+            self._episode_reward = 0.0
+        cols: Dict[str, list] = {k: [] for k in
+                                 (SB.OBS, SB.ACTIONS, SB.REWARDS,
+                                  NEXT_OBS, SB.DONES)}
+        for _ in range(num_steps):
+            if self._rng.random() < epsilon:
+                a = int(self._rng.integers(self.env.action_size))
+            else:
+                q = self._q(params, self._obs[None, :])
+                a = int(np.argmax(np.asarray(q[0])))
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            cols[SB.OBS].append(self._obs)
+            cols[SB.ACTIONS].append(a)
+            cols[SB.REWARDS].append(reward)
+            cols[NEXT_OBS].append(next_obs)
+            # a TRUNCATED episode is not terminal for bootstrapping
+            cols[SB.DONES].append(bool(terminated))
+            self._episode_reward += reward
+            if terminated or truncated:
+                self._episode_rewards.append(self._episode_reward)
+                self._obs, _ = self.env.reset()
+                self._episode_reward = 0.0
+            else:
+                self._obs = next_obs
+        batch = SampleBatch({
+            SB.OBS: np.asarray(cols[SB.OBS], np.float32),
+            SB.ACTIONS: np.asarray(cols[SB.ACTIONS], np.int32),
+            SB.REWARDS: np.asarray(cols[SB.REWARDS], np.float32),
+            NEXT_OBS: np.asarray(cols[NEXT_OBS], np.float32),
+            SB.DONES: np.asarray(cols[SB.DONES], np.bool_),
+        })
+        rewards, self._episode_rewards = self._episode_rewards, []
+        ref = put(dict(batch))
+        return [ref], len(batch), {"episode_rewards": rewards}
+
+
+class DQNLearner:
+    """Jitted double-DQN update + periodic target sync."""
+
+    def __init__(self, module: QNetworkModule, *, lr: float = 1e-3,
+                 gamma: float = 0.99, target_update_freq: int = 200,
+                 huber_delta: float = 1.0, seed: int = 0):
+        import jax
+        import optax
+
+        self.module = module
+        self.gamma = gamma
+        self.huber_delta = huber_delta
+        self.target_update_freq = target_update_freq
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params)
+        self.opt = optax.adam(lr)
+        self.opt_state = self.opt.init(self.params)
+        self._updates = 0
+        self._step = jax.jit(self._update_impl)
+
+    def _loss(self, params, target_params, batch):
+        import jax.numpy as jnp
+
+        q = self.module.forward(params, batch[SB.OBS])
+        q_sa = q[jnp.arange(q.shape[0]), batch[SB.ACTIONS]]
+        # double DQN: online net picks a', target net evaluates it
+        q_next_online = self.module.forward(params, batch[NEXT_OBS])
+        a_next = jnp.argmax(q_next_online, axis=-1)
+        q_next_target = self.module.forward(target_params,
+                                            batch[NEXT_OBS])
+        q_next = q_next_target[jnp.arange(a_next.shape[0]), a_next]
+        not_done = 1.0 - batch[SB.DONES].astype(jnp.float32)
+        target = batch[SB.REWARDS] + self.gamma * not_done * \
+            jax.lax.stop_gradient(q_next)
+        td = q_sa - target
+        # Huber loss (reference: DQN's clipped TD error)
+        d = self.huber_delta
+        loss = jnp.where(jnp.abs(td) <= d, 0.5 * td ** 2,
+                         d * (jnp.abs(td) - 0.5 * d)).mean()
+        return loss, {"td_error_mean": jnp.abs(td).mean(), "loss": loss}
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        import jax
+        import optax
+
+        grads, metrics = jax.grad(
+            lambda p: self._loss(p, target_params, batch),
+            has_aux=True)(params)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._step(
+            self.params, self.target_params, self.opt_state, jb)
+        self._updates += 1
+        if self._updates % self.target_update_freq == 0:
+            import jax
+            self.target_params = jax.tree_util.tree_map(
+                lambda x: x, self.params)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+
+class DQNConfig:
+    """Builder mirroring the PPO/IMPALA config surface (reference:
+    ``AlgorithmConfig`` chaining)."""
+
+    def __init__(self):
+        self.env_creator: Optional[Callable] = None
+        self.num_rollout_workers = 1
+        self.fragment_length = 128
+        self.hidden = (64, 64)
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.train_batch_size = 64
+        self.updates_per_iter = 64
+        self.buffer_capacity = 50_000
+        self.learning_starts = 1_000
+        self.target_update_freq = 200
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 4_000
+        self.seed = 0
+
+    def environment(self, env_creator: Callable) -> "DQNConfig":
+        self.env_creator = env_creator
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None
+                 ) -> "DQNConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "DQNConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DQN training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        if self.env_creator is None:
+            raise ValueError("call .environment(env_creator) first")
+        return DQN(self)
+
+
+class DQN:
+    """Iterate ``train()``: collect with decaying epsilon → replay →
+    minibatch double-DQN updates (reference: ``dqn.py`` training_step —
+    sample, store, replay, update-target)."""
+
+    def __init__(self, config: DQNConfig):
+        env = config.env_creator()
+        module_config = {"observation_size": env.observation_size,
+                         "action_size": env.action_size,
+                         "hidden": config.hidden}
+        self.config = config
+        self.module = QNetworkModule(**module_config)
+        self.learner = DQNLearner(
+            self.module, lr=config.lr, gamma=config.gamma,
+            target_update_freq=config.target_update_freq,
+            seed=config.seed)
+        self.buffer = ReplayBuffer.remote(config.buffer_capacity,
+                                          seed=config.seed)
+        self.workers = [
+            _DQNRolloutWorker.remote(config.env_creator, module_config,
+                                     seed=config.seed + i)
+            for i in range(config.num_rollout_workers)]
+        self._steps_sampled = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._episode_rewards: List[float] = []
+
+    # ----------------------------------------------------------- train
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._steps_sampled / max(1, c.epsilon_decay_steps))
+        return c.epsilon_initial + frac * (c.epsilon_final
+                                           - c.epsilon_initial)
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.perf_counter()
+        weights = self.learner.get_weights()
+        eps = self._epsilon()
+        outs = get([w.collect.remote(weights, c.fragment_length, eps)
+                    for w in self.workers])
+        adds = []
+        for wrapped, count, stats in outs:
+            self._steps_sampled += count
+            self._episode_rewards.extend(stats["episode_rewards"])
+            adds.append(self.buffer.add.remote(wrapped, count))
+        buffer_size = max(get(adds)) if adds else 0
+
+        metrics: Dict[str, float] = {}
+        n_updates = 0
+        if buffer_size >= min(c.learning_starts, c.buffer_capacity):
+            frag_refs = get(self.buffer.sample_refs.remote(
+                c.updates_per_iter))
+            for wrapped in frag_refs:
+                frag = SampleBatch(get(wrapped[0]))
+                idx = self._rng.integers(0, len(frag),
+                                         size=c.train_batch_size)
+                mb = SampleBatch({k: v[idx] for k, v in frag.items()})
+                metrics = self.learner.update(mb)
+                n_updates += 1
+
+        recent = self._episode_rewards[-20:]
+        return {
+            "num_env_steps_sampled": self._steps_sampled,
+            "num_updates": n_updates,
+            "buffer_size": buffer_size,
+            "epsilon": round(eps, 4),
+            "episode_reward_mean": (float(np.mean(recent))
+                                    if recent else float("nan")),
+            "time_this_iter_s": time.perf_counter() - t0,
+            **metrics,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self) -> None:
+        from .. import kill
+        for w in self.workers:
+            try:
+                kill(w)
+            except Exception:
+                pass
+        try:
+            kill(self.buffer)
+        except Exception:
+            pass

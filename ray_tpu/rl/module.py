@@ -70,3 +70,31 @@ class DiscretePolicyModule:
         logp = jax.nn.log_softmax(logits)[
             jnp.arange(logits.shape[0]), action]
         return action, logp, value
+
+
+class QNetworkModule:
+    """MLP state-action value network: obs -> Q[B, A] (reference:
+    DQN's default model — same torso family as the policy module)."""
+
+    def __init__(self, observation_size: int, action_size: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.observation_size = observation_size
+        self.action_size = action_size
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        keys = jax.random.split(rng, len(self.hidden) + 1)
+        params: Dict[str, Any] = {"torso": []}
+        n_in = self.observation_size
+        for i, h in enumerate(self.hidden):
+            params["torso"].append(_dense_init(keys[i], n_in, h,
+                                               math.sqrt(2.0)))
+            n_in = h
+        params["q"] = _dense_init(keys[-1], n_in, self.action_size, 0.01)
+        return params
+
+    def forward(self, params, obs) -> jax.Array:
+        x = obs
+        for layer in params["torso"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        return x @ params["q"]["w"] + params["q"]["b"]

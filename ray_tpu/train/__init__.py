@@ -24,6 +24,7 @@ from .result import Result  # noqa: F401
 from .session import (  # noqa: F401
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     report,
 )
 from .trainer import JaxTrainer  # noqa: F401

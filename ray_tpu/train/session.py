@@ -21,7 +21,8 @@ class TrainContext:
                  results_queue, latest_checkpoint: Optional[Checkpoint],
                  config: Optional[Dict[str, Any]] = None,
                  storage_path: Optional[str] = None,
-                 experiment_name: str = "train"):
+                 experiment_name: str = "train",
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.results_queue = results_queue
@@ -29,7 +30,20 @@ class TrainContext:
         self.config = config or {}
         self.storage_path = storage_path
         self.experiment_name = experiment_name
+        self.dataset_shards = dataset_shards or {}
         self.iteration = 0
+
+    def get_dataset_shard(self, name: str = "train"):
+        """This worker's streaming shard of the trainer's ``datasets``
+        (reference: ``ray.train.get_dataset_shard``); a
+        ``data.DataIterator`` — iterate ``iter_device_batches(...)`` to
+        feed the step function."""
+        try:
+            return self.dataset_shards[name]
+        except KeyError:
+            raise KeyError(
+                f"no dataset {name!r} was passed to JaxTrainer(datasets=...)"
+                f"; have {sorted(self.dataset_shards)}") from None
 
     # reference: ray.train.get_context() surface
     def get_world_rank(self) -> int:
@@ -83,3 +97,8 @@ def report(metrics: Dict[str, Any],
 def get_checkpoint() -> Optional[Checkpoint]:
     """Latest checkpoint to resume from (set on restart after failure)."""
     return get_context().latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """Module-level convenience (reference: ``ray.train.get_dataset_shard``)."""
+    return get_context().get_dataset_shard(name)

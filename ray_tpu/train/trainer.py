@@ -50,13 +50,15 @@ class _TrainWorker:
         self.experiment_name = experiment_name
 
     def run(self, loop_fn: Callable, config: Dict[str, Any],
-            results_queue, resume_ckpt_path: Optional[str]):
+            results_queue, resume_ckpt_path: Optional[str],
+            dataset_shards: Optional[Dict[str, Any]] = None):
         resume = (Checkpoint(resume_ckpt_path)
                   if resume_ckpt_path else None)
         ctx = TrainContext(self.rank, self.world_size, results_queue,
                            resume, config=config,
                            storage_path=self.storage_path,
-                           experiment_name=self.experiment_name)
+                           experiment_name=self.experiment_name,
+                           dataset_shards=dataset_shards)
         _set_session(ctx)
         try:
             if _loop_takes_config(loop_fn):
@@ -92,7 +94,8 @@ class JaxTrainer:
                  *,
                  train_loop_config: Optional[Dict[str, Any]] = None,
                  scaling_config: Optional[ScalingConfig] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self._loop = train_loop_per_worker
         self._loop_config = train_loop_config or {}
         self._scaling = scaling_config or ScalingConfig()
@@ -100,6 +103,11 @@ class JaxTrainer:
         self._failure = self._run_config.failure_config or FailureConfig()
         self._ckpt_config = (self._run_config.checkpoint_config
                              or CheckpointConfig())
+        # {name: ray_tpu.data.Dataset} — each split into one streaming
+        # shard per worker at fit() (and again per elastic restart);
+        # workers consume via session.get_dataset_shard(name)
+        # (reference: Train datasets= + data_config.py streaming split)
+        self._datasets = datasets or {}
 
     # ------------------------------------------------------------------ fit
     def fit(self) -> Result:
@@ -121,11 +129,19 @@ class JaxTrainer:
         while True:
             queue = Queue()
             gang = self._spawn_gang(name, storage)
+            # fresh streaming shards per attempt: the pipeline re-executes
+            # from the start on an elastic restart
+            shard_sets = {
+                ds_name: ds.streaming_split(self._scaling.num_workers)
+                for ds_name, ds in self._datasets.items()}
             try:
                 refs = [w.run.remote(self._loop, self._loop_config, queue,
                                      latest_ckpt.path if latest_ckpt
-                                     else None)
-                        for w in gang["workers"]]
+                                     else None,
+                                     {ds_name: shards[rank]
+                                      for ds_name, shards
+                                      in shard_sets.items()})
+                        for rank, w in enumerate(gang["workers"])]
                 pending = list(refs)
                 while pending:
                     _drain(queue, exp_dir, saved_ckpts, self._ckpt_config,
@@ -165,6 +181,12 @@ class JaxTrainer:
                     queue.shutdown()
                 except Exception:
                     pass
+                # shard queues + their feeder threads must die with the
+                # attempt, or elastic restarts leak a queue-actor set
+                # (and the pinned block refs inside) per retry
+                for shards in shard_sets.values():
+                    for shard in shards:
+                        shard.shutdown()
 
         # surface the persisted copy of the final checkpoint if any
         final_ckpt = Checkpoint(saved_ckpts[-1]) if saved_ckpts else \

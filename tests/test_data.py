@@ -174,9 +174,11 @@ def test_streaming_high_water_mark_bounded(rtpu_init):
         peak = max(peak, used)
     assert total >= n_blocks * block_bytes          # everything flowed
     # the operator windows bound residency: 8 (source+fused task op) +
-    # 4 (actor pool in-flight) + slack for frees still in flight — far
-    # below the 30-block dataset
-    assert peak < 22 * block_bytes, f"peak {peak} vs total {total}"
+    # 4 (actor pool in-flight) + frees still in their ref-zero grace
+    # window (CONFIG.ref_zero_grace_ms absorbs borrower races at the
+    # cost of slightly later frees) — still far below the 30-block
+    # dataset
+    assert peak < 24 * block_bytes, f"peak {peak} vs total {total}"
 
 
 def test_actor_pool_materialize(rtpu_init):
