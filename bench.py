@@ -37,7 +37,46 @@ def _chip_gen() -> str:
     return gen if gen in PEAK_FLOPS else "v5e"
 
 
+def _acquire_backend_or_die(timeout_s: float) -> None:
+    """Initialize the JAX backend under a bounded watchdog.
+
+    A wedged TPU plugin *hangs* in an acquire-retry sleep inside
+    `jax.devices()` instead of raising (BENCH_r04: rc=1 UNAVAILABLE,
+    MULTICHIP_r04: rc=124 timeout), so the probe runs in a daemon
+    thread and the main thread gives up after `timeout_s`, emitting a
+    distinguishable JSON error artifact rather than wedging the driver.
+    """
+    import threading
+
+    done = {}
+
+    def probe():
+        try:
+            done["devices"] = len(jax.devices())
+        except Exception as exc:  # backend raised (e.g. UNAVAILABLE)
+            done["error"] = f"{type(exc).__name__}: {exc}"
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    err = None
+    if t.is_alive():
+        err = (f"TPU backend init exceeded {timeout_s:.0f}s "
+               "(chip unacquirable; acquire-retry wedge)")
+    elif "error" in done:
+        err = f"TPU backend init failed: {done['error']}"
+    if err is not None:
+        print(json.dumps({
+            "metric": "gpt_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": err,
+        }), flush=True)
+        os._exit(1)
+
+
 def main():
+    _acquire_backend_or_die(
+        float(os.environ.get("RTPU_BENCH_ACQUIRE_TIMEOUT", "240")))
     from ray_tpu.models import (GPT, gpt2_medium, init_train_state,
                                 make_optimizer, make_train_step)
     from ray_tpu.models.training import batch_shardings, flops_per_token

@@ -24,17 +24,22 @@ from .block import Block, block_from_rows
 DEFAULT_ROWS_PER_BLOCK = 4096
 
 
-def expand_paths(paths, extension: Optional[str] = None) -> List[str]:
+def expand_paths(paths, extension=None) -> List[str]:
     """Files / dirs / globs → sorted file list (reference:
-    ``file_based_datasource.py`` path expansion)."""
+    ``file_based_datasource.py`` path expansion). ``extension`` may be
+    one suffix, a tuple of suffixes, or None (match everything)."""
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
+    exts = ((extension,) if isinstance(extension, str) else extension)
     out: List[str] = []
     for p in paths:
         p = os.fspath(p)
         if os.path.isdir(p):
-            pat = f"*{extension}" if extension else "*"
-            out.extend(sorted(_glob.glob(os.path.join(p, pat))))
+            pats = [f"*{e}" for e in exts] if exts else ["*"]
+            hits = set()
+            for pat in pats:
+                hits.update(_glob.glob(os.path.join(p, pat)))
+            out.extend(sorted(hits))
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(_glob.glob(p)))
         else:
@@ -88,22 +93,49 @@ class CSVDatasource(FileBasedDatasource):
     def read_file(self, path: str) -> Iterator[Block]:
         import csv
 
+        # Dtypes are decided ONCE PER FILE (cheap text pre-pass), then
+        # applied to every block: per-block inference would give one
+        # column different dtypes in different blocks (int64 in an
+        # all-numeric block, object where an "n/a" appears), and
+        # block_concat would silently promote the numeric rows to
+        # strings.
+        dtypes = _infer_csv_dtypes(path)
         with open(path, newline="") as f:
             for blk in self._batched_rows(csv.DictReader(f)):
-                # column-wise all-or-nothing numeric inference: per-cell
-                # parsing would give a column DIFFERENT dtypes in
-                # different blocks of one file (int64 here, strings
-                # where an "n/a" appears), breaking block_concat
-                yield {k: _numeric_column(v) for k, v in blk.items()}
+                yield {k: (v.astype(dtypes[k])
+                           if dtypes.get(k) is not None else v)
+                       for k, v in blk.items()}
 
 
-def _numeric_column(col: np.ndarray) -> np.ndarray:
-    for dtype in (np.int64, np.float64):
-        try:
-            return col.astype(dtype)
-        except (TypeError, ValueError):
-            continue
-    return col
+def _infer_csv_dtypes(path: str) -> Dict[str, Any]:
+    """Per-column dtype for a whole CSV file: int64 if every cell parses
+    as int, else float64 if every cell parses as float, else None
+    (keep strings)."""
+    import csv
+
+    with open(path, newline="") as f:
+        reader = csv.DictReader(f)
+        state: Dict[str, Any] = {k: np.int64
+                                 for k in (reader.fieldnames or [])}
+        for row in reader:
+            undecided = False
+            for k, dt in state.items():
+                if dt is None:
+                    continue
+                undecided = True
+                val = row.get(k)
+                try:
+                    if not (-2**63 <= int(val) < 2**63):
+                        raise OverflowError  # would not fit int64
+                except (TypeError, ValueError, OverflowError):
+                    try:
+                        float(val)
+                        state[k] = np.float64
+                    except (TypeError, ValueError):
+                        state[k] = None
+            if not undecided:
+                break
+    return state
 
 
 class JSONDatasource(FileBasedDatasource):
@@ -179,11 +211,7 @@ class NumpyDatasource(FileBasedDatasource):
     """.npy (one array -> {"data": rows}) and .npz (one column per
     entry) (reference: ``datasource/numpy_datasource.py``)."""
 
-    extension = ".npy"
-
-    def __init__(self, paths, **kw):
-        self.extension = None if str(paths).endswith(".npz") else ".npy"
-        super().__init__(paths, **kw)
+    extension = (".npy", ".npz")
 
     def read_file(self, path: str) -> Iterator[Block]:
         if path.endswith(".npz"):

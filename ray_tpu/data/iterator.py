@@ -125,12 +125,24 @@ def streaming_split(dataset, n: int, *,
             # error): every consumer must see the error, not hang on a
             # stream that never ends
             end_item = ("__stream_error__", repr(e))
-        for q in queues:
-            try:
-                q.put(end_item, block=True, timeout=5.0)
-            except Exception:
-                # consumer tore this queue down (shutdown/restart)
-                pass
+        from ..util.queue import Full
+        # The sentinel MUST land: a consumer that is merely slow
+        # (bounded queue full across a long train step) raises Full on
+        # timeout — keep retrying. Round-robin over the still-pending
+        # queues so one permanently-full queue (dead consumer, live
+        # queue actor) can't starve the others of their sentinel. Drop
+        # a queue only when its actor is gone (shutdown/teardown).
+        pending = list(queues)
+        while pending:
+            still = []
+            for q in pending:
+                try:
+                    q.put(end_item, block=True, timeout=2.0)
+                except Full:
+                    still.append(q)
+                except Exception:
+                    pass
+            pending = still
 
     threading.Thread(target=feed, daemon=True,
                      name="rtpu-data-feeder").start()

@@ -149,6 +149,7 @@ class DQNLearner:
         self._step = jax.jit(self._update_impl)
 
     def _loss(self, params, target_params, batch):
+        import jax
         import jax.numpy as jnp
 
         q = self.module.forward(params, batch[SB.OBS])

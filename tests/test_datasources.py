@@ -166,3 +166,37 @@ def test_trainer_streaming_ingest(rtpu_init):
     # every row consumed exactly once across the gang
     assert sum(by_rank.values()) == sum(range(400))
     assert all(t > 0 for t in by_rank.values())
+
+
+def test_read_csv_dtype_consistent_across_blocks(rtpu_init, tmp_path):
+    """ADVICE r04: dtype inference is per-FILE, not per-block — a late
+    "n/a" must make the whole column strings, not just its block."""
+    p = tmp_path / "mixed.csv"
+    rows = [str(i) for i in range(20)] + ["n/a", "21"]
+    p.write_text("x,y\n" + "\n".join(f"{v},{i}" for i, v in
+                                     enumerate(rows)) + "\n")
+    ds = rd.read_csv(str(p), rows_per_block=8)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 3
+    # x: poisoned by "n/a" → strings everywhere; y: int64 everywhere
+    assert all(b["x"].dtype.kind in ("U", "O") for b in blocks)
+    assert all(b["y"].dtype == np.int64 for b in blocks)
+    f = tmp_path / "floaty.csv"
+    f.write_text("a\n1\n2.5\n3\n")
+    blk = list(rd.read_csv(str(f)).iter_blocks())[0]
+    assert blk["a"].dtype == np.float64
+
+
+def test_read_numpy_npz_list_and_dir(rtpu_init, tmp_path):
+    """ADVICE r04: .npz detection must work for list inputs and
+    directories (str(paths) endswith was wrong for both)."""
+    np.savez(tmp_path / "z.npz", a=np.arange(4), b=np.ones(4))
+    rows = list(rd.read_numpy([str(tmp_path / "z.npz")]).iter_rows())
+    assert len(rows) == 4 and set(rows[0]) == {"a", "b"}
+    d = tmp_path / "npzdir"
+    d.mkdir()
+    np.savez(d / "one.npz", a=np.arange(3))
+    np.save(d / "two.npy", np.arange(5, dtype=np.int64))
+    ds = rd.read_numpy(str(d))
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 2  # both the npz and the npy were found
