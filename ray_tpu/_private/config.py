@@ -170,3 +170,23 @@ class _Config:
 
 
 CONFIG = _Config()
+
+
+def fw_importable_without_path() -> bool:
+    """True when ray_tpu is pip-installed (editable or wheel), i.e. a
+    spawned interpreter can ``import ray_tpu`` with no PYTHONPATH help.
+    Dev checkouts run via cwd/PYTHONPATH return False and worker spawn
+    injects the framework root (reference: ``python/setup.py:103`` —
+    the reference is always installed; here both modes work)."""
+    global _FW_INSTALLED
+    if _FW_INSTALLED is None:
+        try:
+            import importlib.metadata as _md
+            _md.distribution("ray-tpu")
+            _FW_INSTALLED = True
+        except Exception:
+            _FW_INSTALLED = False
+    return _FW_INSTALLED
+
+
+_FW_INSTALLED = None

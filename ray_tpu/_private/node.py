@@ -1933,7 +1933,9 @@ class NodeService:
         if not (worker_runtime_env
                 and "working_dir" in worker_runtime_env):
             extra = [p for p in _user_sys_paths() if p not in have]
-        if fw_root not in have and fw_root not in extra:
+        from .config import fw_importable_without_path
+        if (not fw_importable_without_path() and fw_root not in have
+                and fw_root not in extra):
             extra.append(fw_root)
         if extra:
             env["PYTHONPATH"] = ((pp + os.pathsep if pp else "")

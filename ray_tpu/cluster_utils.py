@@ -98,10 +98,13 @@ class Cluster:
         else:
             cmd += ["--address", self.gcs_address]
         env = dict(os.environ)
-        # the framework may be importable only via the driver's cwd
+        # dev checkouts: the framework may be importable only via the
+        # driver's cwd; installed builds need no path help
+        from ._private.config import fw_importable_without_path
         fw_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         pp = env.get("PYTHONPATH", "")
-        if fw_root not in pp.split(os.pathsep):
+        if (not fw_importable_without_path()
+                and fw_root not in pp.split(os.pathsep)):
             env["PYTHONPATH"] = (pp + os.pathsep if pp else "") + fw_root
         if extra_env:
             env.update(extra_env)

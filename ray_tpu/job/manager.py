@@ -125,10 +125,13 @@ class JobManager:
                 if env_cwd:
                     cwd = env_cwd
             # the framework itself must stay importable from the job
+            # (dev checkouts only; installed builds import anywhere)
+            from .._private.config import fw_importable_without_path
             fw_root = os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))
             pp = env.get("PYTHONPATH", "")
-            if fw_root not in pp.split(os.pathsep):
+            if (not fw_importable_without_path()
+                    and fw_root not in pp.split(os.pathsep)):
                 env["PYTHONPATH"] = (pp + os.pathsep if pp else "") + fw_root
             with open(log_path, "ab") as out:
                 proc = subprocess.Popen(

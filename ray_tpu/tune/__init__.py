@@ -27,5 +27,12 @@ from .search import (  # noqa: F401
     sample_from,
     uniform,
 )
+from .searcher import (  # noqa: F401
+    BasicVariantSearcher,
+    ConcurrencyLimiter,
+    OptunaSearcher,
+    Searcher,
+    TPESearcher,
+)
 from .tuner import TuneConfig, Tuner  # noqa: F401
 from ..train.session import get_checkpoint, get_context, report  # noqa: F401

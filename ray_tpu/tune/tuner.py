@@ -39,6 +39,9 @@ class TuneConfig:
     max_concurrent_trials: int = 4
     scheduler: Any = None
     search_seed: int = 0
+    # a Searcher (tune.searcher) — when set, trials are created lazily
+    # from its suggest() stream instead of BasicVariantGenerator
+    search_alg: Any = None
 
 
 @remote
@@ -104,7 +107,7 @@ def _jsonable(x):
 
 
 def _drain_reports(queue, by_id, exp_dir, scheduler, stop_trial, exploit,
-                   launch, Empty) -> None:
+                   launch, Empty, on_result=None) -> None:
     """Apply every queued report: record history, persist checkpoints,
     let the scheduler stop/exploit running trials."""
     while True:
@@ -123,6 +126,8 @@ def _drain_reports(queue, by_id, exp_dir, scheduler, stop_trial, exploit,
         trial.iteration += 1
         metrics.setdefault("training_iteration", trial.iteration)
         trial.history.append(metrics)
+        if on_result is not None:
+            on_result(trial, metrics)
         if payload.get("checkpoint_path"):
             src = payload["checkpoint_path"]
             dst = os.path.join(exp_dir, trial.trial_id,
@@ -188,8 +193,15 @@ class Tuner:
         os.makedirs(exp_dir, exist_ok=True)
         scheduler = self._tune.scheduler or FIFOScheduler()
 
+        searcher = self._tune.search_alg
         if self._restored_trials is not None:
             trials = self._restored_trials
+            searcher = None     # restored experiments rerun as journaled
+        elif searcher is not None:
+            from .searcher import FINISHED  # noqa: F401
+            searcher.set_search_properties(
+                self._tune.metric, self._tune.mode, self._param_space)
+            trials = []         # created lazily from suggest()
         else:
             gen = BasicVariantGenerator(self._param_space,
                                         self._tune.num_samples,
@@ -201,6 +213,8 @@ class Tuner:
         by_id = {t.trial_id: t for t in trials}
         pending = [t for t in trials if t.status == "PENDING"]
         running: List[Trial] = []
+        n_created = len(trials)
+        search_done = searcher is None
 
         def launch(trial: Trial) -> None:
             trial.actor = _TrialActor.remote()
@@ -223,6 +237,11 @@ class Tuner:
                     pass
                 trial.actor = None
             scheduler.on_trial_complete(trial)
+            # PBT exploit re-launches as PENDING — not a completion
+            if searcher is not None and status in ("TERMINATED", "ERROR"):
+                searcher.on_trial_complete(
+                    trial.trial_id, trial.last_metrics() or None,
+                    error=status == "ERROR")
 
         def persist() -> None:
             state = {
@@ -240,14 +259,45 @@ class Tuner:
             with open(tmp, "w") as f:
                 json.dump(state, f)
             os.replace(tmp, os.path.join(exp_dir, "experiment.json"))
+            if searcher is not None:
+                try:
+                    searcher.save(os.path.join(exp_dir,
+                                               "searcher_state.pkl"))
+                except Exception:
+                    pass   # search state is best-effort, like the ref
 
-        while pending or running:
+        def on_result(trial: Trial, metrics: Dict[str, Any]) -> None:
+            if searcher is not None:
+                searcher.on_trial_result(trial.trial_id, metrics)
+
+        while pending or running or not search_done:
+            if not search_done:
+                from .searcher import FINISHED
+                while (n_created < self._tune.num_samples
+                       and len(running) + len(pending)
+                       < self._tune.max_concurrent_trials):
+                    tid = f"{name}_{n_created:05d}"
+                    cfg = searcher.suggest(tid)
+                    if cfg is FINISHED:
+                        search_done = True
+                        break
+                    if cfg is None:    # e.g. ConcurrencyLimiter at cap
+                        break
+                    t = Trial(tid, cfg)
+                    trials.append(t)
+                    by_id[tid] = t
+                    pending.append(t)
+                    n_created += 1
+                if n_created >= self._tune.num_samples:
+                    search_done = True
             while pending and len(running) < \
                     self._tune.max_concurrent_trials:
                 launch(pending.pop(0))
+            if not running and not pending and not search_done:
+                time.sleep(0.02)   # searcher momentarily out of configs
 
             _drain_reports(queue, by_id, exp_dir, scheduler, stop_trial,
-                           self._exploit, launch, Empty)
+                           self._exploit, launch, Empty, on_result)
 
             # completed/failed trial actors. A finished actor's reports
             # are all queued before its run-ref resolves, so drain once
@@ -260,7 +310,7 @@ class Tuner:
                 if done:
                     _drain_reports(queue, by_id, exp_dir, scheduler,
                                    stop_trial, self._exploit, launch,
-                                   Empty)
+                                   Empty, on_result)
                 for ref in done:
                     trial = refs[ref]
                     if trial not in running:
@@ -274,7 +324,7 @@ class Tuner:
         # final drain: reports can land between the last drain and the
         # trial-completion check that ended the loop
         _drain_reports(queue, by_id, exp_dir, scheduler, stop_trial,
-                       self._exploit, launch, Empty)
+                       self._exploit, launch, Empty, on_result)
         persist()
         try:
             queue.shutdown()
