@@ -10,8 +10,17 @@ prefetch (``Dataset.iter_device_batches``) feeding jax arrays straight
 onto the chips.
 """
 
+from .aggregate import (  # noqa: F401
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
 from .block import BlockMetadata, block_metadata  # noqa: F401
-from .dataset import ActorPoolStrategy, Dataset  # noqa: F401
+from .dataset import ActorPoolStrategy, Dataset, GroupedData  # noqa: F401
 from .iterator import DataIterator  # noqa: F401
 from .read_api import (  # noqa: F401
     from_generators,

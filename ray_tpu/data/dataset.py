@@ -135,10 +135,12 @@ def _slice_block(blk: Block, start: int, stop: int) -> Block:
 
 
 @remote
-def _shuffle_block(blk: Block, seed: int) -> Block:
-    rng = np.random.default_rng(seed)
-    n = B.block_num_rows(blk)
-    return B.block_take(blk, rng.permutation(n))
+def _add_const_key(blk: Block) -> Block:
+    """Tag every row with one shared key so Dataset.aggregate can ride
+    the groupby engine as a single-group reduction."""
+    out = dict(blk)
+    out["__all__"] = np.zeros(B.block_num_rows(blk), np.int8)
+    return out
 
 
 class Dataset:
@@ -359,17 +361,48 @@ class Dataset:
                             else parts[0])
         return Dataset(block_refs=out_refs)
 
-    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Block-order permutation + intra-block shuffle (the reference's
-        push-based full shuffle is a scale feature; this is the standard
-        approximation for training-ingest pipelines)."""
-        mat = self.materialize()
-        refs = list(mat._block_refs or [])
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(len(refs))
-        shuffled = [_shuffle_block.remote(refs[i], int(rng.integers(2**31)))
-                    for i in order]
-        return Dataset(block_refs=shuffled)
+    def random_shuffle(self, seed: Optional[int] = None, *,
+                       merge_window: int = 8) -> "Dataset":
+        """True all-to-all row shuffle through the push-based shuffle
+        engine: every output block draws rows from every input block
+        (reference: ``_internal/push_based_shuffle.py``)."""
+        from .shuffle import random_shuffle_blocks
+        refs = list(self.streaming_block_refs())
+        return Dataset(block_refs=random_shuffle_blocks(
+            refs, seed=seed, merge_window=merge_window))
+
+    def sort(self, key: str, descending: bool = False, *,
+             num_partitions: Optional[int] = None,
+             merge_window: int = 8) -> "Dataset":
+        """Distributed sort by a column (reference: ``Dataset.sort`` via
+        ``planner/exchange/sort_task_spec.py``): sample → range
+        partition through the shuffle engine → per-partition sort.
+        Output blocks are globally ordered."""
+        from .shuffle import sort_blocks
+        refs = list(self.streaming_block_refs())
+        return Dataset(block_refs=sort_blocks(
+            refs, key, descending=descending,
+            num_partitions=num_partitions, merge_window=merge_window))
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Hash-based group-by (reference: ``Dataset.groupby`` →
+        ``grouped_data.py``)."""
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Whole-dataset aggregation without a key (reference:
+        ``Dataset.aggregate``): each block folds to constant-key agg
+        state, merged in remote tasks, finalized here."""
+        from .shuffle import groupby_aggregate_blocks
+
+        refs = [_add_const_key.remote(r)
+                for r in self.streaming_block_refs()]
+        out_refs = groupby_aggregate_blocks(refs, "__all__", list(aggs),
+                                            num_partitions=1)
+        blk = B.block_concat([b for b in get(out_refs)
+                              if B.block_num_rows(b)])
+        return {agg.name: blk[agg.name][0] if B.block_num_rows(blk)
+                else None for agg in aggs}
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by round-robin over blocks (reference:
@@ -525,3 +558,58 @@ class Dataset:
         stages = "+".join(s[0] for s in self._stages) or "read"
         return (f"Dataset(blocks={self._num_input_blocks()}, "
                 f"stages={stages})")
+
+
+class GroupedData:
+    """Result of ``Dataset.groupby(key)`` (reference:
+    ``python/ray/data/grouped_data.py``): aggregations ride the
+    push-based shuffle engine — raw rows hash-partition by key, fold
+    into per-group state at first merge, and finalize into one output
+    block per partition."""
+
+    def __init__(self, dataset: "Dataset", key: str):
+        self._ds = dataset
+        self._key = key
+
+    def aggregate(self, *aggs, num_partitions: Optional[int] = None,
+                  merge_window: int = 8) -> "Dataset":
+        from .shuffle import groupby_aggregate_blocks
+        refs = list(self._ds.streaming_block_refs())
+        return Dataset(block_refs=groupby_aggregate_blocks(
+            refs, self._key, list(aggs), num_partitions=num_partitions,
+            merge_window=merge_window))
+
+    def map_groups(self, fn: Callable, *, num_partitions: Optional[int]
+                   = None, merge_window: int = 8) -> "Dataset":
+        """Apply ``fn(group_block) -> block/rows`` once per group. Each
+        group lands whole in one partition via the hash shuffle."""
+        from .shuffle import map_groups_blocks
+        refs = list(self._ds.streaming_block_refs())
+        return Dataset(block_refs=map_groups_blocks(
+            refs, self._key, fn, num_partitions=num_partitions,
+            merge_window=merge_window))
+
+    # convenience single-agg forms (reference: GroupedData.count/...)
+    def count(self) -> "Dataset":
+        from .aggregate import Count
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> "Dataset":
+        from .aggregate import Sum
+        return self.aggregate(Sum(on))
+
+    def mean(self, on: str) -> "Dataset":
+        from .aggregate import Mean
+        return self.aggregate(Mean(on))
+
+    def min(self, on: str) -> "Dataset":
+        from .aggregate import Min
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> "Dataset":
+        from .aggregate import Max
+        return self.aggregate(Max(on))
+
+    def std(self, on: str, ddof: int = 1) -> "Dataset":
+        from .aggregate import Std
+        return self.aggregate(Std(on, ddof))
