@@ -135,10 +135,23 @@ def main():
         count += 1
     dt = time.perf_counter() - t0
     gib = count * 4 * 128 / 1024 / dt
+    # this row is memcpy-bound: a put is exactly one copy into shm, so
+    # the machine's single-thread copy bandwidth caps it — measure that
+    # ceiling here so the artifact shows efficiency vs THIS box, not
+    # just vs the reference's (multi-GB/s-memcpy) release hardware
+    src = np.ones(128 << 20, np.uint8)
+    dst = np.empty(128 << 20, np.uint8)
+    dst[:] = 0                                  # fault pages in
+    t0 = time.perf_counter()
+    for _ in range(3):
+        np.copyto(dst, src)
+    ceiling = 3 * 128 / 1024 / (time.perf_counter() - t0)
     rec = {"metric": "single_client_put_gigabytes",
            "value": round(gib, 3), "unit": "GiB/s",
            "vs_baseline": round(
-               gib / BASELINES["single_client_put_gigabytes"], 3)}
+               gib / BASELINES["single_client_put_gigabytes"], 3),
+           "detail": {"hw_one_copy_ceiling_gibs": round(ceiling, 2),
+                      "vs_hw_ceiling": round(gib / ceiling, 3)}}
     print(json.dumps(rec), flush=True)
     results.append(rec)
 

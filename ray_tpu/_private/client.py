@@ -46,6 +46,9 @@ class CoreClient:
         # with the node it is connected to, so object payloads must ride
         # the socket (set by init() when the head's host differs)
         self.wire_data_plane = False
+        # worker runtime hooks: return unstarted leased tasks on block
+        self.on_worker_block = None
+        self.on_worker_unblock = None
         self.reader = ObjectReader()
         self._futures: Dict[int, Future] = {}
         self._req_lock = threading.Lock()
@@ -354,13 +357,19 @@ class CoreClient:
             self._wire_put(oid, *self._serialize_flat(value))
             return ObjectRef(oid)
         meta = self._store_value(oid, value)
-        if meta.shm_name is not None or meta.arena_ref is not None:
-            # Large object: block until the node store adopts it, so the
-            # store's budget accounting (and spilling) stays ahead of the
-            # writer — matches the reference, where ``ray.put`` returns only
-            # after the plasma seal (``core_worker.cc:1141``).
+        if meta.shm_name is not None:
+            # Dedicated-segment object: block until the node store adopts
+            # it, so the store's budget accounting (and spilling) stays
+            # ahead of the writer — matches the reference, where
+            # ``ray.put`` returns only after the plasma seal
+            # (``core_worker.cc:1141``).
             self._sync_put(meta)
         else:
+            # Inline or arena-backed: the arena slot was charged against
+            # the store budget at ALLOC_OBJECT, so the seal can be
+            # one-way — same-socket frame order keeps it ahead of any
+            # later get()/free() from this client (saves one blocking
+            # round trip per large put)
             self._send(P.PUT_OBJECT, meta)
         return ObjectRef(oid)
 
@@ -453,11 +462,17 @@ class CoreClient:
             return fut.result(timeout=0.004)
         except FuturesTimeout:
             pass
+        if self.on_worker_block is not None:
+            # hand back unstarted leased tasks BEFORE announcing the
+            # block: they may be the very children this get() waits on
+            self.on_worker_block()
         self._send(P.NOTIFY_BLOCKED, None)
         try:
             return fut.result()
         finally:
             self._send(P.NOTIFY_UNBLOCKED, None)
+            if self.on_worker_unblock is not None:
+                self.on_worker_unblock()
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:

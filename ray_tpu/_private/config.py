@@ -31,6 +31,13 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                          "(native/object_arena.cpp) when the library builds; "
                          "falls back to per-object segments"),
     # --- scheduler ---
+    "worker_pipeline_depth": (int, 1,
+                              "EXPERIMENTAL: max tasks leased to one busy "
+                              "worker (running + queued) when more same-shape "
+                              "tasks are pending than idle workers. Default 1 "
+                              "(off): lease rescue for nested blocking tasks "
+                              "has known races under heavy contention "
+                              "(reference: worker-lease reuse)"),
     "scheduler_spread_threshold": (float, 0.5,
                                    "hybrid policy: pack below this node utilization, "
                                    "spread above (reference: scheduler_spread_threshold)"),

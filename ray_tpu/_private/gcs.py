@@ -621,6 +621,8 @@ class GlobalControlPlane:
         """Publish frees whose grace expired with the count still zero.
         Called from the edge paths and from heartbeats (so zeros drain
         even on an otherwise-idle cluster)."""
+        if not self._zero_pending:
+            return          # lock-free fast path: called per edge event
         freed = []
         now = time.time()
         with self._lock:

@@ -17,8 +17,23 @@ _ID_SIZE = 16
 _local = threading.local()
 
 
+_POOL_REFILL = 256 * _ID_SIZE
+
+
 def _random_bytes(n: int = _ID_SIZE) -> bytes:
-    return os.urandom(n)
+    """Entropy from a thread-local urandom pool: one syscall buys 256
+    ids (a per-task urandom() call was ~13% of submission CPU in the
+    core microbench)."""
+    buf = getattr(_local, "pool", b"")
+    if len(buf) < n:
+        buf = os.urandom(max(_POOL_REFILL, n))
+    _local.pool = buf[n:]
+    return buf[:n]
+
+
+# a forked child must never replay the parent's pooled entropy
+# (workers here are spawned, not forked — this is belt-and-braces)
+os.register_at_fork(after_in_child=lambda: setattr(_local, "pool", b""))
 
 
 class BaseID:

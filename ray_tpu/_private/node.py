@@ -51,6 +51,13 @@ class _Worker:
     conn_key: Optional[int] = None
     state: str = "STARTING"
     task: Optional["_TaskRecord"] = None
+    # same-shape tasks leased to this worker beyond the running one
+    # (reference: worker-lease reuse — the owner pushes tasks to a
+    # leased worker without a per-task raylet round trip,
+    # ``lease_policy.h`` / ``direct_task_transport.h``). Only the
+    # running task holds the resource charge; the charge transfers on
+    # each completion since every piped task has the identical shape.
+    pipeline: "deque" = field(default_factory=deque)
     actor_id: Optional[ActorID] = None
     started_at: float = field(default_factory=time.monotonic)
     # when the current task/actor work was assigned — pooled workers are
@@ -107,7 +114,20 @@ class _TaskRecord:
     queued_at: float = field(default_factory=time.monotonic)
     # exclusive TPU slot indices held while running (whole-chip demands)
     accel_ids: Optional[List[int]] = None
+    # True once a worker handed this lease back (it sat behind a
+    # blocking task): never pipe it again — one bounce max per task,
+    # so rescue storms terminate and normal scheduling takes over
+    no_pipe: bool = False
 
+
+
+_PIPE_DEBUG = os.environ.get("RTPU_PIPE_DEBUG") == "1"
+
+
+def _pdbg(msg):
+    if _PIPE_DEBUG:
+        print(f"[pipe {os.getpid()} {time.monotonic():.3f}] {msg}",
+              file=sys.stderr, flush=True)
 
 class _PendingQueue:
     """Ready-to-dispatch tasks bucketed by scheduling shape
@@ -397,6 +417,11 @@ class NodeService:
         self._env_spawn_error: Dict[str, str] = {}
 
         self._pending = _PendingQueue(self._rec_env_key)  # ready-to-dispatch
+        # per-worker EXECUTE outbox: sends coalesce across one event
+        # (a SUBMIT_BATCH of 100 tiny tasks becomes one frame per
+        # worker, not 100); flushed at the end of every dispatcher
+        # event by _dispatch_loop
+        self._exec_outbox: Dict[WorkerID, List[tuple]] = {}
         # True while draining a SUBMIT_BATCH: _queue_local defers its
         # per-spec _dispatch so the burst is one scheduling pass
         self._in_batch = False
@@ -987,6 +1012,27 @@ class NodeService:
             except Exception:
                 import traceback
                 traceback.print_exc(file=sys.stderr)
+            finally:
+                if self._exec_outbox:
+                    self._flush_exec_outbox()
+
+    def _send_execute(self, w: _Worker, item: tuple) -> None:
+        """Queue an EXECUTE for this worker; coalesced per event."""
+        self._exec_outbox.setdefault(w.worker_id, []).append(item)
+
+    def _flush_exec_outbox(self) -> None:
+        outbox, self._exec_outbox = self._exec_outbox, {}
+        for wid, items in outbox.items():
+            w = self._workers.get(wid)
+            if w is None or w.conn is None:
+                continue
+            try:
+                if len(items) == 1:
+                    w.conn.send((P.EXECUTE_TASK, items[0]))
+                else:
+                    w.conn.send((P.EXECUTE_BATCH, items))
+            except OSError:
+                self._events.put(("conn_closed", w.conn_key))
 
     # ------------------------------------------------------------- handling
     def _handle(self, item: tuple) -> None:
@@ -1072,6 +1118,8 @@ class NodeService:
             self._submit_actor_task(payload)
         elif op == P.NOTIFY_BLOCKED:
             self._worker_blocked(key)
+        elif op == P.RETURN_LEASED:
+            self._on_return_leased(key, payload)
         elif op == P.NOTIFY_UNBLOCKED:
             self._worker_unblocked(key)
         elif op == P.PROFILE_EVENT:
@@ -1093,6 +1141,9 @@ class NodeService:
             self.store.free(payload)
         elif op == P.TASK_DONE:
             self._task_done(key, *payload)
+        elif op == P.TASK_DONE_BATCH:
+            for done in payload:
+                self._task_done(key, *done)
         elif op == P.GEN_ITEM:
             self._gen_item(*payload)
         elif op == P.GEN_NEXT:
@@ -1537,11 +1588,60 @@ class NodeService:
                     break
                 self._pending.popleft(shape)
                 self._assign(rec, wid)
+            if bucket:
+                self._pipeline_into_busy(shape, bucket)
             self._pending.drop_empty(shape)
         # fresh budget for future submissions: the blacklist applies to
         # tasks pending in this pass, not to the env forever
         for env in failed_envs:
             self._env_spawn_failures.pop(env, None)
+
+    def _pipeline_into_busy(self, shape: tuple, bucket: deque) -> None:
+        """Lease extra same-shape tasks onto workers already running that
+        shape, up to a small depth (reference: worker-lease reuse — the
+        owner keeps pushing tasks to a leased worker instead of paying a
+        scheduler round trip per task, ``direct_task_transport.h``).
+        Piped tasks hold NO resource charge: the worker executes
+        serially, so only its running task consumes resources; the
+        charge transfers on each completion (identical shape). Excluded:
+        placement groups (per-bundle pools) and TPU tasks (exclusive
+        accelerator slot ids differ per task)."""
+        depth = CONFIG.worker_pipeline_depth
+        pg_key, res, _env = shape
+        if (depth <= 1 or pg_key is not None
+                or any(r == "TPU" for r, _ in res)):
+            return
+        for w in self._workers.values():
+            if not bucket:
+                break
+            if (w.state != "BUSY" or w.conn is None or w.task is None
+                    or w.task.kind != "task"
+                    or w.task.blocked_depth > 0
+                    or getattr(w.task, "_pending_shape", None) != shape):
+                # never lease behind a task blocked in get(): the queue
+                # would park until it unblocks (and could BE what it
+                # waits on)
+                continue
+            while bucket and len(w.pipeline) + 1 < depth:
+                rec = bucket[0]
+                if rec.no_pipe or rec.kind != "task":
+                    # bounced-once tasks and actor creations (which
+                    # share a shape bucket with plain tasks) wait for a
+                    # normal assignment
+                    break
+                self._pending.popleft(shape)
+                if rec.cancelled:
+                    continue
+                rec.worker_id = w.worker_id
+                self._running[rec.spec.task_id] = rec
+                self._record_event(rec.spec, "RUNNING")
+                self._pin_deps(rec)
+                rec.spec.accel_ids = None
+                w.pipeline.append(rec)
+                _pdbg(f"pipe {rec.spec.task_id.hex()[:8]} -> "
+                      f"{w.worker_id.hex()[:6]}")
+                self._send_execute(w, (rec.kind, rec.spec, rec.deps,
+                                       rec.actor_spec))
 
     def _spill_starved_pending(self) -> None:
         """Re-route queued tasks that have starved locally while another
@@ -1697,6 +1797,46 @@ class NodeService:
             pool = self._rec_charge_pool(rec)
             if pool is not None:
                 sched.add(pool, {"CPU": cpu})
+        self._dispatch()
+
+    def _on_return_leased(self, conn_key: int, task_ids: list) -> None:
+        """A worker entering a blocking get() handed back its unstarted
+        leased tasks (they could be the very children it waits on —
+        nested submission would deadlock behind it). The WORKER drained
+        its own queue, so it will never run these; requeueing them here
+        is double-execution-free by construction."""
+        wid = self._conn_worker.get(conn_key)
+        w = self._workers.get(wid) if wid is not None else None
+        if w is None:
+            return
+        by_id = {r.spec.task_id: r for r in w.pipeline}
+        for tid in task_ids:
+            rec = by_id.get(tid)
+            _pdbg(f"return_leased {tid.hex()[:8]} from "
+                  f"{w.worker_id.hex()[:6]} found={rec is not None}")
+            if rec is None:
+                # handoff raced the bounce: a completion already
+                # promoted this lease to w.task (charge and all) while
+                # the worker was handing it back — un-assign it here or
+                # it stays "running" forever on a worker that never
+                # queued it
+                cur = w.task
+                if cur is not None and cur.spec.task_id == tid:
+                    self._running.pop(tid, None)
+                    self._unpin_deps(cur)
+                    self._release_charge(cur)
+                    cur.worker_id = None
+                    cur.no_pipe = True
+                    if w.state == "BUSY":
+                        self._mark_idle(w)
+                    self._pending.append(cur)
+                continue
+            w.pipeline.remove(rec)
+            self._running.pop(tid, None)
+            self._unpin_deps(rec)
+            rec.worker_id = None
+            rec.no_pipe = True
+            self._pending.append(rec)
         self._dispatch()
 
     def _worker_unblocked(self, conn_key: int) -> None:
@@ -1982,17 +2122,18 @@ class NodeService:
         self._record_event(rec.spec, "RUNNING")
         self._pin_deps(rec)
         rec.spec.accel_ids = rec.accel_ids
-        try:
-            w.conn.send((P.EXECUTE_TASK, (rec.kind, rec.spec, rec.deps,
-                                          rec.actor_spec)))
-        except OSError:
-            self._events.put(("conn_closed", w.conn_key))
+        _pdbg(f"assign {rec.spec.task_id.hex()[:8]} ({rec.kind}) -> "
+              f"{w.worker_id.hex()[:6]}")
+        self._send_execute(w, (rec.kind, rec.spec, rec.deps,
+                               rec.actor_spec))
 
     # ------------------------------------------------------------ completion
     def _task_done(self, conn_key: int, task_id, metas: List[ObjectMeta],
                    error: Optional[bytes], kind: str,
                    gen_count: Optional[int] = None) -> None:
         rec = self._running.pop(task_id, None)
+        _pdbg(f"done {task_id.hex()[:8]} known={rec is not None} "
+              f"metas={len(metas)} err={error is not None}")
         if rec is not None:
             self._unpin_deps(rec)
         if gen_count is not None:
@@ -2013,9 +2154,20 @@ class NodeService:
             self._actor_creation_done(rec, error)
             self._dispatch()
             return
-        self._release_charge(rec)
-        if w is not None and w.state == "BUSY":
-            self._mark_idle(w)
+        if rec.kind == "task" and w is not None and w.pipeline:
+            # leased pipeline: hand the charge to the next task of the
+            # identical shape — the pool totals don't move
+            nxt = w.pipeline.popleft()
+            _pdbg(f"handoff {w.worker_id.hex()[:6]}: "
+                  f"{rec.spec.task_id.hex()[:8]} -> "
+                  f"{nxt.spec.task_id.hex()[:8]}")
+            nxt.charge, rec.charge = rec.charge, None
+            w.task = nxt
+            w.assigned_at = time.monotonic()
+        else:
+            self._release_charge(rec)
+            if w is not None and w.state == "BUSY":
+                self._mark_idle(w)
         if rec.kind == "actor_call" and w is not None:
             w.task = None
         self._dispatch()
@@ -2427,11 +2579,7 @@ class NodeService:
         self._record_event(rec.spec, "RUNNING")
         self._pin_deps(rec)
         rec.spec.accel_ids = st.get("accel_ids")
-        try:
-            w.conn.send((P.EXECUTE_TASK, ("actor_call", rec.spec, rec.deps,
-                                          None)))
-        except OSError:
-            self._events.put(("conn_closed", w.conn_key))
+        self._send_execute(w, ("actor_call", rec.spec, rec.deps, None))
 
     def _kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
         rec = self.gcs.get_actor(actor_id)
@@ -2621,6 +2769,23 @@ class NodeService:
         rec = self._running.get(task_id)
         if rec is not None and rec.worker_id is not None:
             w = self._workers.get(rec.worker_id)
+            if w is not None and rec is not w.task and rec in w.pipeline:
+                # leased-but-not-running: a signal would hit the wrong
+                # task; tell the worker to skip it when its turn comes
+                # and fail the returns here (the skip reply is
+                # meta-less)
+                rec.cancelled = True
+                w.pipeline.remove(rec)
+                self._running.pop(task_id, None)
+                self._unpin_deps(rec)
+                if w.conn is not None:
+                    try:
+                        w.conn.send((P.CANCEL_QUEUED, task_id))
+                    except OSError:
+                        pass
+                self._fail_returns(rec.spec,
+                                   exceptions.TaskCancelledError(task_id))
+                return
             if w is not None and w.proc is not None:
                 import signal
                 try:
@@ -2823,8 +2988,10 @@ class NodeService:
                 "actor worker killed by the memory monitor (node out of "
                 "memory)" if w.oom_victim else "actor worker process died")
             return
-        rec = w.task
-        if rec is not None:
+        # the running task AND any leased pipeline behind it died with
+        # the process; only the running one holds a charge
+        for rec in ([w.task] if w.task is not None else []) \
+                + list(w.pipeline):
             self._running.pop(rec.spec.task_id, None)
             self._unpin_deps(rec)
             self._release_charge(rec)
@@ -2849,6 +3016,7 @@ class NodeService:
             else:
                 self._fail_returns(rec.spec, exceptions.WorkerCrashedError(
                     f"worker died while running {rec.spec.name}"))
+        w.pipeline.clear()
         self._dispatch()
 
     def _on_node_event(self, payload) -> None:

@@ -22,7 +22,7 @@ import sys
 import threading
 import traceback
 from concurrent.futures import ThreadPoolExecutor
-from queue import SimpleQueue
+from queue import Empty, SimpleQueue
 from typing import Any, Dict, List, Optional
 
 from .. import exceptions
@@ -50,6 +50,18 @@ class WorkerRuntime:
         self._actor_instance: Any = None
         self._actor_spec: Optional[P.ActorSpec] = None
         self._exec_queue: "SimpleQueue" = SimpleQueue()
+        # TASK_DONE coalescing: results of tasks that arrived together
+        # (one EXECUTE_BATCH) leave together — one frame instead of N.
+        # flush_after marks the last task of each received batch.
+        self._done_buf: List[tuple] = []
+        self._done_lock = threading.Lock()
+        self._cancelled_queued: set = set()
+        # True while the exec thread sits in a blocking get(); the
+        # reader bounces task leases that arrive in that window (the
+        # exec-thread drain at block entry can't see them)
+        self._blocked_in_get = False
+        self.client.on_worker_block = self._return_leased_tasks
+        self.client.on_worker_unblock = self._on_unblock
         self._exec_thread = threading.Thread(target=self._exec_loop,
                                              daemon=True)
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -68,16 +80,74 @@ class WorkerRuntime:
                 os._exit(0)
             op, payload = msg
             if op == P.EXECUTE_TASK:
-                kind, spec, deps, actor_spec = payload
-                if kind == "actor_call" and (
-                        self._pool is not None or self._aio_loop is not None):
-                    self._dispatch_concurrent(spec, deps)
-                else:
-                    self._exec_queue.put((kind, spec, deps, actor_spec))
+                if not self._maybe_bounce(payload):
+                    self._enqueue_execute(payload, flush_after=True)
+            elif op == P.EXECUTE_BATCH:
+                # every task flushes its DONE: withholding an early
+                # result until a batch's LAST task finishes would stall
+                # callers behind an arbitrarily long successor (the
+                # batch frame still amortizes the node->worker side)
+                for item in payload:
+                    if not self._maybe_bounce(item):
+                        self._enqueue_execute(item, flush_after=True)
+            elif op == P.CANCEL_QUEUED:
+                self._cancelled_queued.add(payload)
             elif op == P.SHUTDOWN:
                 os._exit(0)
             else:
                 self.client.handle_message(op, payload)
+
+    def _maybe_bounce(self, payload) -> bool:
+        """Reader-side: a plain-task lease arriving while the exec
+        thread is blocked in get() would park until it unblocks; hand
+        it straight back instead (it never enters the queue, so it can
+        never also run here)."""
+        if not self._blocked_in_get or payload[0] != "task" \
+                or self._actor_spec is not None:
+            return False
+        self.conn.send((P.RETURN_LEASED, [payload[1].task_id]))
+        return True
+
+    def _on_unblock(self) -> None:
+        self._blocked_in_get = False
+
+    def _return_leased_tasks(self) -> None:
+        """Called on the exec thread as its current task enters a
+        blocking get(): drain our own queue of unstarted plain tasks
+        and hand them back to the node (they may be the children this
+        get() waits on — leaving them parked behind us deadlocks
+        nested submission). We are the queue's only consumer, so a
+        drained task can never also run here: requeueing is
+        double-execution-free."""
+        # about to block: completed-task DONEs must not sit buffered —
+        # the node would keep charging/attributing this worker to a
+        # task that already finished
+        self._flush_dones()
+        if self._actor_instance is not None or self._actor_spec is not None:
+            return          # actor queues hold ordered actor calls
+        self._blocked_in_get = True
+        returned: List = []
+        while True:
+            try:
+                item = self._exec_queue.get_nowait()
+            except Empty:
+                break
+            if item[0] == "task":
+                returned.append(item[1].task_id)
+            else:           # not leaseable work; keep it queued
+                self._exec_queue.put(item)
+                break
+        if returned:
+            self.conn.send((P.RETURN_LEASED, returned))
+
+    def _enqueue_execute(self, payload, flush_after: bool) -> None:
+        kind, spec, deps, actor_spec = payload
+        if kind == "actor_call" and (
+                self._pool is not None or self._aio_loop is not None):
+            self._dispatch_concurrent(spec, deps)
+        else:
+            self._exec_queue.put((kind, spec, deps, actor_spec,
+                                  flush_after))
 
     def _on_sigint(self, signum, frame) -> None:
         """Cancellation: raise TaskCancelledError inside the task thread
@@ -89,13 +159,36 @@ class WorkerRuntime:
                 ctypes.py_object(exceptions.TaskCancelledError))
 
     def _exec_loop(self) -> None:
+        try:
+            self._exec_loop_inner()
+        except BaseException:
+            # a dying exec thread must not leave a zombie worker (reader
+            # alive, nothing executing): surface and exit so the node
+            # reaps the process and retries its tasks
+            traceback.print_exc(file=sys.stderr)
+            os._exit(1)
+
+    def _exec_loop_inner(self) -> None:
         while True:
-            kind, spec, deps, actor_spec = self._exec_queue.get()
+            kind, spec, deps, actor_spec, flush_after = \
+                self._exec_queue.get()
+            if spec.task_id in self._cancelled_queued:
+                # skipped, not executed: report NO return metas — for a
+                # rescued lease the task re-runs elsewhere and owns
+                # these return ids; for a user cancel the node already
+                # failed the returns itself
+                self._cancelled_queued.discard(spec.task_id)
+                self._queue_done((spec.task_id, [], None, kind, None))
+                if flush_after:
+                    self._flush_dones()
+                continue
             self._current_task_thread = threading.get_ident()
             try:
                 self._run_one(kind, spec, deps, actor_spec)
             finally:
                 self._current_task_thread = None
+            if flush_after:
+                self._flush_dones()
 
     def _dispatch_concurrent(self, spec: P.TaskSpec, deps) -> None:
         if self._aio_loop is not None:
@@ -276,8 +369,7 @@ class WorkerRuntime:
         # still end its stream — gen_count=0 + the error — or consumers
         # parked on item 0 hang forever
         gen_count = 0 if spec.num_returns == -1 else None
-        self.conn.send((P.TASK_DONE,
-                        (spec.task_id, metas, err_bytes, kind, gen_count)))
+        self._queue_done((spec.task_id, metas, err_bytes, kind, gen_count))
         # unconditional: force-traced spans exist even when THIS node's
         # config has tracing off (flush is a no-op on an empty buffer)
         from ..util import tracing
@@ -324,10 +416,31 @@ class WorkerRuntime:
         err_bytes = ser.to_bytes(err) if err is not None else None
         self.client.flush_submissions()
         self.client.flush_refs()
-        self.conn.send((P.TASK_DONE,
-                        (spec.task_id, [], err_bytes, kind, produced)))
+        self._queue_done((spec.task_id, [], err_bytes, kind, produced))
         from ..util import tracing
         tracing.flush()
+
+    def _queue_done(self, payload: tuple) -> None:
+        if self._pool is not None or self._aio_loop is not None:
+            # concurrent actor calls complete outside the exec loop and
+            # in no particular order; deliver each immediately
+            self.conn.send((P.TASK_DONE, payload))
+            return
+        with self._done_lock:
+            self._done_buf.append(payload)
+            flush = len(self._done_buf) >= 32
+        if flush:
+            self._flush_dones()
+
+    def _flush_dones(self) -> None:
+        with self._done_lock:
+            batch, self._done_buf = self._done_buf, []
+        if not batch:
+            return
+        if len(batch) == 1:
+            self.conn.send((P.TASK_DONE, batch[0]))
+        else:
+            self.conn.send((P.TASK_DONE_BATCH, batch))
 
     def _store_return(self, oid: ObjectID, value: Any) -> ObjectMeta:
         smeta, views = ser.serialize(value)

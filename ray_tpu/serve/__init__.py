@@ -17,8 +17,14 @@ from .api import (  # noqa: F401
     get_deployment_handle,
     run,
     shutdown,
+    start,
     start_http,
     stop_http,
 )
 from .batching import batch  # noqa: F401
 from .handle import DeploymentHandle  # noqa: F401
+from .multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
+from .proxy import proxy_addresses  # noqa: F401

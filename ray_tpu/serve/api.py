@@ -110,6 +110,11 @@ def delete(name: str) -> None:
 def shutdown() -> None:
     stop_http()
     try:
+        from .proxy import stop_proxies
+        stop_proxies()
+    except Exception:   # noqa: BLE001 — proxies are best-effort on exit
+        pass
+    try:
         controller = get_actor(_CONTROLLER_NAME)
     except ValueError:
         return
@@ -165,13 +170,13 @@ class _GatewayHandler:
         return handle.stream(arg)
 
 
-def start_http(host: str = "127.0.0.1", port: int = 8000) -> str:
-    global _http_server
+def _gateway_server(host: str = "127.0.0.1", port: int = 0):
+    """Build + start one gateway HTTP server; returns (server, address).
+    Used by the driver-local ``start_http`` and by each per-node
+    ``ProxyActor`` (reference: one HTTPProxy per node,
+    ``_private/proxy.py:613``)."""
     from .._private.http_util import HttpServerBase, JsonHandler
 
-    # restarting replaces the gateway: the old thread/port must not be
-    # orphaned (they'd hold the bind until process exit)
-    stop_http()
     gateway = _GatewayHandler()
 
     class Handler(JsonHandler):
@@ -244,9 +249,38 @@ def start_http(host: str = "127.0.0.1", port: int = 8000) -> str:
     class Gateway(HttpServerBase):
         thread_name = "rtpu-serve-http"
 
-    _http_server = Gateway(Handler, host=host, port=port)
-    _http_server.start()
-    return f"http://{host}:{_http_server.port}"
+    server = Gateway(Handler, host=host, port=port)
+    server.start()
+    return server, f"http://{host}:{server.port}"
+
+
+def start_http(host: str = "127.0.0.1", port: int = 8000) -> str:
+    global _http_server
+    # restarting replaces the gateway: the old thread/port must not be
+    # orphaned (they'd hold the bind until process exit)
+    stop_http()
+    _http_server, addr = _gateway_server(host, port)
+    return addr
+
+
+def start(*, proxy_location: str = "HeadOnly",
+          http_host: str = "127.0.0.1", http_port: int = 0):
+    """Start Serve ingress (reference: ``serve.start(http_options=...)``
+    + ``ProxyStateManager``). ``proxy_location``:
+
+    * ``"HeadOnly"`` — one gateway in this driver process.
+    * ``"EveryNode"`` — a detached ProxyActor per alive cluster node,
+      each serving every deployment; returns {node_id_hex: address}.
+    """
+    _get_or_create_controller()
+    if proxy_location == "EveryNode":
+        from .proxy import ensure_proxies
+        return ensure_proxies(http_host, http_port)
+    if proxy_location == "HeadOnly":
+        return start_http(http_host, http_port or 8000)
+    raise ValueError(
+        f"proxy_location must be 'HeadOnly' or 'EveryNode', "
+        f"got {proxy_location!r}")
 
 
 def stop_http() -> None:

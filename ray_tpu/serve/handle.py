@@ -25,6 +25,10 @@ class DeploymentHandle:
         self._controller = controller
         self._replicas: List[Any] = []
         self._inflight: Dict[int, int] = {}
+        # multiplexing cache locality: model_id -> replica index that
+        # loaded it last (reference: router prefers replicas whose
+        # multiplexed-model cache holds the request's model)
+        self._model_affinity: Dict[str, int] = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
         self._rng = random.Random()
@@ -36,7 +40,16 @@ class DeploymentHandle:
             return
         replicas = get(self._controller.get_replicas.remote(
             self.deployment_name))
+        def ids(rs):
+            return [getattr(r, "_actor_id", None) for r in rs]
+
         with self._lock:
+            if ids(replicas) != ids(self._replicas):
+                # the replica SET changed (stable actor ids — fresh
+                # handle objects deserialize per poll): indices shifted,
+                # cached model->replica affinities point at the wrong
+                # replicas now
+                self._model_affinity.clear()
             self._replicas = replicas
             self._inflight = {i: self._inflight.get(i, 0)
                               for i in range(len(replicas))}
@@ -62,18 +75,53 @@ class DeploymentHandle:
             if idx in self._inflight and self._inflight[idx] > 0:
                 self._inflight[idx] -= 1
 
+    def _pick_for_model(self, model_id: str) -> int:
+        """Prefer the replica that already holds this model (LRU cache
+        locality); fall back to power-of-two and remember the choice."""
+        with self._lock:
+            idx = self._model_affinity.get(model_id)
+            if idx is not None and idx < len(self._replicas):
+                self._inflight[idx] = self._inflight.get(idx, 0) + 1
+                return idx
+        idx = self._pick()
+        with self._lock:
+            if len(self._model_affinity) >= 256:
+                self._model_affinity.pop(
+                    next(iter(self._model_affinity)))
+            self._model_affinity[model_id] = idx
+        return idx
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """Per-request routing options (reference:
+        ``handle.options(multiplexed_model_id=...)``)."""
+        if multiplexed_model_id is None:
+            return self
+        return _ModelBoundHandle(self, multiplexed_model_id)
+
     # ---------------------------------------------------------------- calls
     def remote(self, *args, **kwargs):
         """Route one request; returns an ObjectRef."""
+        return self._route(None, *args, **kwargs)
+
+    def _route(self, model_id, *args, **kwargs):
         self._refresh()
         for attempt in range(3):
-            idx = self._pick()
+            idx = (self._pick() if model_id is None
+                   else self._pick_for_model(model_id))
             with self._lock:
                 replica = self._replicas[idx]
             try:
-                ref = replica.handle_request.remote(*args, **kwargs)
+                if model_id is None:
+                    ref = replica.handle_request.remote(*args, **kwargs)
+                else:
+                    ref = replica.handle_request_mux.remote(
+                        model_id, *args, **kwargs)
             except Exception:
                 self._done(idx)
+                with self._lock:
+                    if self._model_affinity.get(model_id) == idx:
+                        del self._model_affinity[model_id]
                 self._refresh(force=True)
                 continue
             # in-flight slot released when the response is consumed
@@ -85,16 +133,28 @@ class DeploymentHandle:
         return a generator, whose items arrive as they are produced
         (reference: Serve streaming responses over ObjectRefGenerator).
         Returns an iterator of item VALUES."""
+        return self._route_stream(None, *args, **kwargs)
+
+    def _route_stream(self, model_id, *args, **kwargs):
         self._refresh()
         for attempt in range(3):
-            idx = self._pick()
+            idx = (self._pick() if model_id is None
+                   else self._pick_for_model(model_id))
             with self._lock:
                 replica = self._replicas[idx]
             try:
-                gen = replica.handle_request.options(
-                    num_returns="streaming").remote(*args, **kwargs)
+                if model_id is None:
+                    gen = replica.handle_request.options(
+                        num_returns="streaming").remote(*args, **kwargs)
+                else:
+                    gen = replica.handle_request_mux.options(
+                        num_returns="streaming").remote(
+                            model_id, *args, **kwargs)
             except Exception:
                 self._done(idx)
+                with self._lock:
+                    if self._model_affinity.get(model_id) == idx:
+                        del self._model_affinity[model_id]
                 self._refresh(force=True)
                 continue
             return _TrackedStream(gen, self, idx)
@@ -102,6 +162,29 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self._controller))
+
+
+class _ModelBoundHandle:
+    """A DeploymentHandle view with a fixed multiplexed model id."""
+
+    def __init__(self, handle: DeploymentHandle, model_id: str):
+        self._handle = handle
+        self._model_id = model_id
+
+    def remote(self, *args, **kwargs):
+        return self._handle._route(self._model_id, *args, **kwargs)
+
+    def stream(self, *args, **kwargs):
+        return self._handle._route_stream(self._model_id,
+                                          *args, **kwargs)
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None):
+        if multiplexed_model_id is None:
+            return self
+        return _ModelBoundHandle(self._handle, multiplexed_model_id)
+
+    def __getattr__(self, name):
+        return getattr(self._handle, name)
 
 
 class _TrackedStream:

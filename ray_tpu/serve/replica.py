@@ -35,6 +35,37 @@ class Replica:
             with self._depth_lock:
                 self._depth -= 1
 
+    def handle_request_mux(self, model_id: str, *args, **kwargs):
+        """handle_request with the request's multiplexed model id bound
+        for ``serve.get_multiplexed_model_id()`` (reference: proxy sets
+        the serve request context's multiplexed_model_id). A streaming
+        handler's generator BODY runs lazily during iteration, so the
+        binding must wrap the iteration too, not just the call."""
+        import inspect
+
+        from .multiplex import (_reset_request_model_id,
+                                _set_request_model_id)
+        token = _set_request_model_id(model_id)
+        try:
+            result = self.handle_request(*args, **kwargs)
+        finally:
+            _reset_request_model_id(token)
+        if inspect.isgenerator(result):
+            return _iter_with_model_id(model_id, result)
+        return result
+
+    def multiplexed_model_ids(self):
+        """Model ids currently loaded by any @serve.multiplexed caches
+        on this replica (router cache-locality signal)."""
+        out = []
+        for v in vars(self._instance).values():
+            if hasattr(v, "model_ids"):
+                try:
+                    out.extend(v.model_ids())
+                except Exception:   # noqa: BLE001 — introspection only
+                    pass
+        return out
+
     def call_method(self, method_name: str, *args, **kwargs):
         with self._depth_lock:
             self._depth += 1
@@ -52,3 +83,15 @@ class Replica:
     def reconfigure(self, user_config) -> None:
         if hasattr(self._instance, "reconfigure"):
             self._instance.reconfigure(user_config)
+
+
+def _iter_with_model_id(model_id: str, gen):
+    """Re-bind the request's model id around each step of a streaming
+    handler (thread-pooled replicas: per-thread contexts keep this
+    isolated between concurrent requests)."""
+    from .multiplex import _reset_request_model_id, _set_request_model_id
+    token = _set_request_model_id(model_id)
+    try:
+        yield from gen
+    finally:
+        _reset_request_model_id(token)

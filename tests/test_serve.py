@@ -271,3 +271,91 @@ def test_streaming_http(serve_session):
             stamps.append(_time.time() - t0)
     assert [ln["item"]["i"] for ln in lines] == list(range(5))
     assert stamps[0] < 0.7 * stamps[-1], stamps
+
+
+def test_multiplexed_model_loading(serve_session):
+    """@serve.multiplexed LRU-loads models per replica under a cap and
+    routes by model affinity (reference: serve/multiplex.py)."""
+
+    @serve.deployment(num_replicas=1)
+    class MuxModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+        def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"model": model, "x": x, "loads": list(self.loads)}
+
+    handle = serve.run(MuxModel.bind())
+    r1 = handle.options(multiplexed_model_id="a").remote(1).result()
+    assert r1["model"] == "model-a" and r1["loads"] == ["a"]
+    # cache hit: same model, no reload
+    r2 = handle.options(multiplexed_model_id="a").remote(2).result()
+    assert r2["loads"] == ["a"]
+    # second model fits the cap
+    handle.options(multiplexed_model_id="b").remote(3).result()
+    # third evicts LRU ("a"); re-requesting "a" reloads it
+    handle.options(multiplexed_model_id="c").remote(4).result()
+    r5 = handle.options(multiplexed_model_id="a").remote(5).result()
+    assert r5["loads"] == ["a", "b", "c", "a"]
+    serve.delete("MuxModel")
+
+
+def test_proxy_on_every_node(rtpu_cluster):
+    """serve.start(proxy_location='EveryNode') puts a gateway on each
+    node; a request through ANY node's address reaches the app
+    (reference: proxy_state.py per-node proxies)."""
+    import json
+    import urllib.request
+
+    node = rtpu_cluster.add_node(num_cpus=2)
+    try:
+        @serve.deployment(num_replicas=1)
+        def double(x):
+            return {"doubled": (x or {"v": 0})["v"] * 2}
+
+        serve.run(double.bind())
+        addrs = serve.start(proxy_location="EveryNode")
+        assert len(addrs) == 2, addrs
+        for node_hex, addr in addrs.items():
+            body = json.dumps({"v": 21}).encode()
+            req = urllib.request.Request(
+                f"{addr}/double", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            assert out == {"result": {"doubled": 42}}, (node_hex, out)
+        assert set(serve.proxy_addresses()) == set(addrs)
+    finally:
+        serve.shutdown()
+
+
+def test_multiplexed_streaming(serve_session):
+    """Pin: options(multiplexed_model_id=...).stream() binds the model
+    id both at call time and during generator iteration."""
+
+    @serve.deployment(num_replicas=1)
+    class S:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, mid):
+            return mid
+
+        def __call__(self, n):
+            eager = self.get_model(serve.get_multiplexed_model_id())
+
+            def gen():
+                for i in range(n):
+                    lazy = serve.get_multiplexed_model_id()
+                    yield {"eager": eager, "lazy": lazy}
+            return gen()
+
+    handle = serve.run(S.bind())
+    items = list(handle.options(multiplexed_model_id="mx").stream(2))
+    assert items == [{"eager": "mx", "lazy": "mx"}] * 2, items
+    serve.delete("S")
