@@ -27,7 +27,8 @@ def bench_ppo_learner() -> None:
     throughput)."""
     algo = (PPOConfig()
             .environment(CartPoleEnv)
-            .rollouts(num_rollout_workers=2, rollout_fragment_length=1024)
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=256)
             .training(num_sgd_iter=8, sgd_minibatch_size=512)
             .build())
     iters = 2 if QUICK else 5
@@ -58,7 +59,8 @@ def bench_ppo_learner() -> None:
 def bench_impala_throughput() -> None:
     algo = (ImpalaConfig()
             .environment(CartPoleEnv)
-            .rollouts(num_rollout_workers=4, rollout_fragment_length=512)
+            .rollouts(num_rollout_workers=4, num_envs_per_worker=4,
+                      rollout_fragment_length=128)
             .training(num_sgd_iter=1)
             .build())
     iters = 4 if QUICK else 12
@@ -74,7 +76,7 @@ def bench_impala_throughput() -> None:
         "value": round(sampled / dt, 1),
         "unit": "steps/s",
         "vs_baseline": None,
-        "detail": {"num_rollout_workers": 4},
+        "detail": {"num_rollout_workers": 4, "num_envs_per_worker": 4},
     }), flush=True)
 
 

@@ -102,6 +102,11 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                  "monitor, counted separately from "
                                  "max_retries (reference: task_oom_retries)"),
     # --- health / failure ---
+    "heartbeat_period_ms": (int, 1000,
+                            "resource-view sync cadence: liveness pings "
+                            "every period, the availability payload only "
+                            "when it changed (versioned delta sync, "
+                            "reference: ray_syncer.h:86)"),
     "health_check_period_ms": (int, 3000,
                                "control-plane liveness ping period "
                                "(reference: ray_config_def.h:815)"),

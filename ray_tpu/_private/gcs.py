@@ -59,6 +59,10 @@ class NodeInfo:
     # queued resource demand reported with heartbeats (autoscaler input;
     # reference: ResourceDemandScheduler's load report)
     pending_shapes: List[Dict[str, float]] = field(default_factory=list)
+    # monotonic version of the availability view (RaySyncer-equivalent,
+    # reference: ray_syncer.h:86 versioned snapshots) -- a delayed or
+    # re-ordered heartbeat can never roll the view back
+    resource_version: int = 0
 
     def __getstate__(self):
         # the live service object never crosses the wire
@@ -315,16 +319,28 @@ class GlobalControlPlane:
 
     def heartbeat(self, node_id: NodeID,
                   resources_available: Optional[Dict[str, float]] = None,
-                  pending_shapes: Optional[List[Dict[str, float]]] = None
-                  ) -> None:
+                  pending_shapes: Optional[List[Dict[str, float]]] = None,
+                  version: Optional[int] = None) -> None:
+        """Liveness + versioned resource sync. A payload carrying a
+        version at or below the stored one is a delayed duplicate: it
+        refreshes liveness but must NOT roll the availability view back
+        (reference: RaySyncer versioned snapshots, ray_syncer.h:86).
+        ``resources_available=None`` is the delta protocol "nothing
+        changed" ping -- senders only ship the dict on change."""
         with self._lock:
             info = self.nodes.get(node_id)
             if info:
                 info.last_heartbeat = time.monotonic()
-                if resources_available is not None:
-                    info.resources_available = resources_available
-                if pending_shapes is not None:
-                    info.pending_shapes = pending_shapes
+                stale = (version is not None
+                         and info.resource_version > 0
+                         and version <= info.resource_version)
+                if not stale:
+                    if version is not None:
+                        info.resource_version = version
+                    if resources_available is not None:
+                        info.resources_available = resources_available
+                    if pending_shapes is not None:
+                        info.pending_shapes = pending_shapes
         # heartbeats double as the grace sweeper so pending frees drain
         # even when no further ref edges arrive
         self.sweep_ref_zeros()

@@ -416,6 +416,14 @@ class NodeService:
         self._env_spawn_failures: Dict[str, int] = {}
         self._env_spawn_error: Dict[str, str] = {}
 
+        # versioned resource sync state (RaySyncer-equivalent): a
+        # time-epoch base keeps versions monotonic across a node-process
+        # restart under the same id
+        self._resource_version = int(time.time() * 1000)
+        self._last_hb_at = 0.0
+        self._hb_count = 0
+        self._last_hb_snapshot: Optional[Dict[str, float]] = None
+        self._last_hb_pending: Optional[list] = None
         self._pending = _PendingQueue(self._rec_env_key)  # ready-to-dispatch
         # per-worker EXECUTE outbox: sends coalesce across one event
         # (a SUBMIT_BATCH of 100 tiny tasks becomes one frame per
@@ -742,11 +750,38 @@ class NodeService:
             # RPC can block the dispatcher past the GCS death deadline
             # (health period × threshold), and a healthy node must not be
             # declared dead because one transfer is slow.
-            try:
-                self.gcs.heartbeat(self.node_id, self.available_snapshot(),
-                                   pending_shapes=self.pending_demand())
-            except Exception:
-                pass
+            now_hb = time.monotonic()
+            if now_hb - self._last_hb_at >= \
+                    CONFIG.heartbeat_period_ms / 1000.0:
+                self._last_hb_at = now_hb
+                snap = self.available_snapshot()
+                pend = self.pending_demand()
+                # versioned delta sync (reference: ray_syncer.h:86):
+                # ship the payload when the view changed, bumping the
+                # monotonic version; every Nth beat is a full refresh
+                # so a GCS that lost state (restart) converges even on
+                # an otherwise-idle node
+                self._hb_count += 1
+                changed = (snap != self._last_hb_snapshot
+                           or pend != self._last_hb_pending
+                           or self._hb_count % 10 == 0)
+                if changed:
+                    self._resource_version += 1
+                try:
+                    self.gcs.heartbeat(
+                        self.node_id,
+                        snap if changed else None,
+                        pending_shapes=pend if changed else None,
+                        version=self._resource_version)
+                except Exception:
+                    # the payload did NOT land: leave the last-sent view
+                    # unchanged so the next beat re-detects the delta
+                    # and resends (committing early would drop it)
+                    pass
+                else:
+                    if changed:
+                        self._last_hb_snapshot = snap
+                        self._last_hb_pending = pend
             self._events.put(("timer", self._on_tick))
 
     def _on_tick(self) -> None:
@@ -1141,9 +1176,6 @@ class NodeService:
             self.store.free(payload)
         elif op == P.TASK_DONE:
             self._task_done(key, *payload)
-        elif op == P.TASK_DONE_BATCH:
-            for done in payload:
-                self._task_done(key, *done)
         elif op == P.GEN_ITEM:
             self._gen_item(*payload)
         elif op == P.GEN_NEXT:

@@ -104,7 +104,8 @@ GEN_NEXT = 60           # (req_id, task_id, index) -> INFO_REPLY
                         #   | ("error", err_bytes)
 GEN_CLOSE = 61          # (task_id,) — consumer dropped the generator
 EXECUTE_BATCH = 62      # node -> worker: [EXECUTE_TASK payload, ...]
-TASK_DONE_BATCH = 63    # worker -> node: [TASK_DONE payload, ...]
+# op 63 reserved (was TASK_DONE_BATCH; DONEs leave per task so an
+# early result is never withheld behind a slow batch successor)
 CANCEL_QUEUED = 64      # node -> worker: task_id queued behind current
 RETURN_LEASED = 65      # worker -> node: [task_id] unstarted leased tasks
 

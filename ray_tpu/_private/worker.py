@@ -50,11 +50,6 @@ class WorkerRuntime:
         self._actor_instance: Any = None
         self._actor_spec: Optional[P.ActorSpec] = None
         self._exec_queue: "SimpleQueue" = SimpleQueue()
-        # TASK_DONE coalescing: results of tasks that arrived together
-        # (one EXECUTE_BATCH) leave together — one frame instead of N.
-        # flush_after marks the last task of each received batch.
-        self._done_buf: List[tuple] = []
-        self._done_lock = threading.Lock()
         self._cancelled_queued: set = set()
         # True while the exec thread sits in a blocking get(); the
         # reader bounces task leases that arrive in that window (the
@@ -81,15 +76,15 @@ class WorkerRuntime:
             op, payload = msg
             if op == P.EXECUTE_TASK:
                 if not self._maybe_bounce(payload):
-                    self._enqueue_execute(payload, flush_after=True)
+                    self._enqueue_execute(payload)
             elif op == P.EXECUTE_BATCH:
-                # every task flushes its DONE: withholding an early
-                # result until a batch's LAST task finishes would stall
-                # callers behind an arbitrarily long successor (the
-                # batch frame still amortizes the node->worker side)
+                # the batch frame amortizes the node->worker side; each
+                # task's DONE still leaves individually (withholding an
+                # early result until a batch's last task finished would
+                # stall callers behind an arbitrarily long successor)
                 for item in payload:
                     if not self._maybe_bounce(item):
-                        self._enqueue_execute(item, flush_after=True)
+                        self._enqueue_execute(item)
             elif op == P.CANCEL_QUEUED:
                 self._cancelled_queued.add(payload)
             elif op == P.SHUTDOWN:
@@ -119,10 +114,6 @@ class WorkerRuntime:
         nested submission). We are the queue's only consumer, so a
         drained task can never also run here: requeueing is
         double-execution-free."""
-        # about to block: completed-task DONEs must not sit buffered —
-        # the node would keep charging/attributing this worker to a
-        # task that already finished
-        self._flush_dones()
         if self._actor_instance is not None or self._actor_spec is not None:
             return          # actor queues hold ordered actor calls
         self._blocked_in_get = True
@@ -140,14 +131,13 @@ class WorkerRuntime:
         if returned:
             self.conn.send((P.RETURN_LEASED, returned))
 
-    def _enqueue_execute(self, payload, flush_after: bool) -> None:
+    def _enqueue_execute(self, payload) -> None:
         kind, spec, deps, actor_spec = payload
         if kind == "actor_call" and (
                 self._pool is not None or self._aio_loop is not None):
             self._dispatch_concurrent(spec, deps)
         else:
-            self._exec_queue.put((kind, spec, deps, actor_spec,
-                                  flush_after))
+            self._exec_queue.put((kind, spec, deps, actor_spec))
 
     def _on_sigint(self, signum, frame) -> None:
         """Cancellation: raise TaskCancelledError inside the task thread
@@ -170,25 +160,21 @@ class WorkerRuntime:
 
     def _exec_loop_inner(self) -> None:
         while True:
-            kind, spec, deps, actor_spec, flush_after = \
-                self._exec_queue.get()
+            kind, spec, deps, actor_spec = self._exec_queue.get()
             if spec.task_id in self._cancelled_queued:
                 # skipped, not executed: report NO return metas — for a
                 # rescued lease the task re-runs elsewhere and owns
                 # these return ids; for a user cancel the node already
                 # failed the returns itself
                 self._cancelled_queued.discard(spec.task_id)
-                self._queue_done((spec.task_id, [], None, kind, None))
-                if flush_after:
-                    self._flush_dones()
+                self.conn.send((P.TASK_DONE,
+                                (spec.task_id, [], None, kind, None)))
                 continue
             self._current_task_thread = threading.get_ident()
             try:
                 self._run_one(kind, spec, deps, actor_spec)
             finally:
                 self._current_task_thread = None
-            if flush_after:
-                self._flush_dones()
 
     def _dispatch_concurrent(self, spec: P.TaskSpec, deps) -> None:
         if self._aio_loop is not None:
@@ -369,7 +355,8 @@ class WorkerRuntime:
         # still end its stream — gen_count=0 + the error — or consumers
         # parked on item 0 hang forever
         gen_count = 0 if spec.num_returns == -1 else None
-        self._queue_done((spec.task_id, metas, err_bytes, kind, gen_count))
+        self.conn.send((P.TASK_DONE,
+                        (spec.task_id, metas, err_bytes, kind, gen_count)))
         # unconditional: force-traced spans exist even when THIS node's
         # config has tracing off (flush is a no-op on an empty buffer)
         from ..util import tracing
@@ -416,31 +403,10 @@ class WorkerRuntime:
         err_bytes = ser.to_bytes(err) if err is not None else None
         self.client.flush_submissions()
         self.client.flush_refs()
-        self._queue_done((spec.task_id, [], err_bytes, kind, produced))
+        self.conn.send((P.TASK_DONE,
+                        (spec.task_id, [], err_bytes, kind, produced)))
         from ..util import tracing
         tracing.flush()
-
-    def _queue_done(self, payload: tuple) -> None:
-        if self._pool is not None or self._aio_loop is not None:
-            # concurrent actor calls complete outside the exec loop and
-            # in no particular order; deliver each immediately
-            self.conn.send((P.TASK_DONE, payload))
-            return
-        with self._done_lock:
-            self._done_buf.append(payload)
-            flush = len(self._done_buf) >= 32
-        if flush:
-            self._flush_dones()
-
-    def _flush_dones(self) -> None:
-        with self._done_lock:
-            batch, self._done_buf = self._done_buf, []
-        if not batch:
-            return
-        if len(batch) == 1:
-            self.conn.send((P.TASK_DONE, batch[0]))
-        else:
-            self.conn.send((P.TASK_DONE_BATCH, batch))
 
     def _store_return(self, oid: ObjectID, value: Any) -> ObjectMeta:
         smeta, views = ser.serialize(value)

@@ -13,8 +13,66 @@ client connection), so it keeps answering while drivers come and go.
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import deque
+
 from .._private.http_util import HttpServerBase, JsonHandler
 from ..state import api as state_api
+
+
+class _HistoryCollector:
+    """Ring-buffer time series of cluster utilization (reference:
+    the dashboard's metrics time series, scoped to the capability: a
+    bounded in-head history instead of a Prometheus+Grafana stack).
+    Samples every ``period_s``; 600 samples x 2s = 20 minutes."""
+
+    def __init__(self, node, period_s: float = 2.0, maxlen: int = 600):
+        self._node = node
+        self._period = period_s
+        self.samples: deque = deque(maxlen=maxlen)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-dash-history")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._period):
+            try:
+                self.samples.append(self._sample())
+            except Exception:   # noqa: BLE001 — a bad sample is a gap
+                pass
+
+    def _sample(self) -> dict:
+        node = self._node
+        total = node._cluster_info("resources_total") or {}
+        avail = node._cluster_info("resources_available") or {}
+        tasks = state_api.shape_tasks(node._state_query("tasks", None))
+        by_state: dict = {}
+        for t in tasks:
+            by_state[t.get("state", "?")] =                 by_state.get(t.get("state", "?"), 0) + 1
+        actors = state_api.shape_actors(
+            node._state_query("actors", None))
+        store = node.node_stats("store") or {}
+        return {
+            "ts": time.time(),
+            "cpu_total": total.get("CPU", 0.0),
+            "cpu_used": (total.get("CPU", 0.0)
+                         - avail.get("CPU", 0.0)),
+            "tpu_total": total.get("TPU", 0.0),
+            "tpu_used": (total.get("TPU", 0.0)
+                         - avail.get("TPU", 0.0)),
+            "tasks_running": by_state.get("RUNNING", 0),
+            "tasks_pending": (by_state.get("PENDING", 0)
+                              + by_state.get("QUEUED", 0)),
+            "tasks_finished": by_state.get("FINISHED", 0),
+            "actors_alive": sum(1 for a in actors
+                                if a.get("state") == "ALIVE"),
+            "store_used_bytes": store.get("used_bytes", 0),
+        }
 
 _HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
@@ -31,6 +89,14 @@ _HTML = """<!doctype html>
 <body>
 <h1>ray_tpu dashboard</h1>
 <div id="cluster"></div><div id="err"></div>
+<h2>Utilization history</h2>
+<canvas id="hist" width="860" height="160"
+ style="border:1px solid #ccc"></canvas>
+<div id="histlegend" style="font-size:.8rem"></div>
+<h2>Task drill-down</h2>
+<input id="tid" placeholder="task id (hex or prefix)" size="36">
+<button onclick="drill()">show timeline</button>
+<table id="taskevents"></table>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Tasks (latest state)</h2><table id="tasks"></table>
@@ -70,7 +136,52 @@ async function refresh() {
     document.getElementById("err").textContent = "";
   } catch (e) { document.getElementById("err").textContent = String(e); }
 }
+function drawHistory(samples) {
+  const cv = document.getElementById("hist"), ctx = cv.getContext("2d");
+  ctx.clearRect(0, 0, cv.width, cv.height);
+  if (!samples.length) return;
+  const series = [
+    ["cpu_used", "#c33", s => s.cpu_total ? s.cpu_used / s.cpu_total : 0],
+    ["tasks_running", "#36c",
+     s => s.tasks_running / Math.max(1, ...samples.map(
+       x => x.tasks_running))],
+    ["store_used", "#390",
+     s => s.store_used_bytes / Math.max(1, ...samples.map(
+       x => x.store_used_bytes))],
+  ];
+  for (const [name, color, f] of series) {
+    ctx.strokeStyle = color; ctx.beginPath();
+    samples.forEach((s, i) => {
+      const x = i / Math.max(1, samples.length - 1) * (cv.width - 8) + 4;
+      const y = cv.height - 6 - f(s) * (cv.height - 12);
+      i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+    });
+    ctx.stroke();
+  }
+  const span = ((samples[samples.length-1].ts - samples[0].ts) / 60)
+    .toFixed(1);
+  document.getElementById("histlegend").innerHTML =
+    `<span style="color:#c33">cpu utilization</span> &middot; ` +
+    `<span style="color:#36c">tasks running (rel)</span> &middot; ` +
+    `<span style="color:#390">store used (rel)</span> &middot; ` +
+    `window: ${esc(span)} min`;
+}
+async function drill() {
+  const tid = document.getElementById("tid").value.trim();
+  if (!tid) return;
+  const r = await (await fetch("api/task/" +
+    encodeURIComponent(tid))).json();
+  fill("taskevents", r.events,
+       ["timestamp", "state", "name", "node_id", "task_id"]);
+}
+async function refreshHist() {
+  try {
+    const h = await (await fetch("api/history")).json();
+    drawHistory(h.samples || []);
+  } catch (e) {}
+}
 refresh(); setInterval(refresh, 2000);
+refreshHist(); setInterval(refreshHist, 4000);
 </script></body></html>
 """
 
@@ -78,6 +189,7 @@ refresh(); setInterval(refresh, 2000);
 class _Handler(JsonHandler):
     node = None           # NodeService, set by server factory
     job_manager = None    # optional JobManager
+    history = None        # _HistoryCollector, set by server factory
 
     def do_GET(self):   # noqa: C901 — flat route table
         path = self.path.split("?", 1)[0].rstrip("/")
@@ -122,6 +234,30 @@ class _Handler(JsonHandler):
                 return self._json(200, {
                     "tasks": state_api.summarize_task_rows(tasks),
                     "actors": state_api.summarize_actor_rows(actors)})
+            if path == "/api/history":
+                hist = getattr(self, "history", None)
+                return self._json(200, {
+                    "samples": list(hist.samples) if hist else []})
+            if path.startswith("/api/task/"):
+                # drill-down: every recorded state transition of one
+                # task (id or unique hex prefix), time-ordered
+                tid = path.rsplit("/", 1)[1]
+                events = []
+                for ev in node._state_query("tasks", None) or []:
+                    ev_hex = getattr(ev.get("task_id"), "hex",
+                                     lambda: str(ev.get("task_id")))()
+                    if ev_hex.startswith(tid):
+                        events.append({
+                            "task_id": ev_hex,
+                            "name": ev.get("name"),
+                            "state": ev.get("state"),
+                            "node_id": (ev["node_id"].hex()
+                                        if ev.get("node_id") else None),
+                            "timestamp": ev.get("timestamp"),
+                        })
+                events.sort(key=lambda e: e["timestamp"] or 0)
+                return self._json(200, {"task_id": tid,
+                                        "events": events})
             if path == "/api/jobs":
                 if self.job_manager is None:
                     return self._json(200, {"jobs": []})
@@ -141,5 +277,11 @@ class DashboardServer(HttpServerBase):
     # any network peer without an explicit opt-in (--http-host=0.0.0.0)
     def __init__(self, node, job_manager=None, host: str = "127.0.0.1",
                  port: int = 0):
+        self.history = _HistoryCollector(node)
         super().__init__(_Handler, host=host, port=port,
-                         node=node, job_manager=job_manager)
+                         node=node, job_manager=job_manager,
+                         history=self.history)
+
+    def stop(self) -> None:
+        self.history.stop()
+        super().stop()

@@ -4,7 +4,8 @@ Reference: RLlib (``rllib/``, SURVEY §2.3/§3.6) new stack: `Algorithm`
 owns rollout workers (env sampling actors) and a `LearnerGroup` of
 learner actors for SGD. TPU-native mapping:
 
-  * RolloutWorker actors run envs on CPU hosts and evaluate the policy
+  * EnvRunner actors run (vectorized) envs on CPU hosts and evaluate
+    the policy
     with jitted JAX on host devices — sampling never touches the TPU.
   * The Learner's update is ONE jitted SPMD program (loss + grad + optax)
     over a device mesh; multi-learner data-parallelism is mesh `dp`, not
@@ -23,3 +24,4 @@ from .ppo import PPO, PPOConfig  # noqa: F401
 from .sample_batch import SampleBatch, concat_batches  # noqa: F401
 from .dqn import DQN, DQNConfig, ReplayBuffer  # noqa: F401
 from .module import QNetworkModule  # noqa: F401
+from .vector_env import EnvRunner, VectorEnv  # noqa: F401

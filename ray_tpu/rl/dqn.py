@@ -62,71 +62,6 @@ class ReplayBuffer:
         return [self._frags[i][0] for i in idx]
 
 
-@remote(num_cpus=1)
-class _DQNRolloutWorker:
-    """Epsilon-greedy collection of (obs, action, reward, next_obs,
-    done) transitions; fragments go straight into the object store."""
-
-    def __init__(self, env_creator: Callable, module_config: dict,
-                 seed: int = 0):
-        import jax
-
-        self.env = env_creator()
-        self.module = QNetworkModule(**module_config)
-        self._rng = np.random.default_rng(seed)
-        self._obs = None
-        self._episode_reward = 0.0
-        self._episode_rewards: List[float] = []
-
-        def _q_impl(params, obs):
-            return self.module.forward(params, obs)
-
-        self._q = jax.jit(_q_impl)
-
-    def collect(self, weights, num_steps: int, epsilon: float):
-        """Returns (wrapped fragment ref, count, stats): the fragment is
-        ``put`` here so the replay plane is the shm store."""
-        import jax
-
-        params = jax.tree_util.tree_map(jax.numpy.asarray, weights)
-        if self._obs is None:
-            self._obs, _ = self.env.reset()
-            self._episode_reward = 0.0
-        cols: Dict[str, list] = {k: [] for k in
-                                 (SB.OBS, SB.ACTIONS, SB.REWARDS,
-                                  NEXT_OBS, SB.DONES)}
-        for _ in range(num_steps):
-            if self._rng.random() < epsilon:
-                a = int(self._rng.integers(self.env.action_size))
-            else:
-                q = self._q(params, self._obs[None, :])
-                a = int(np.argmax(np.asarray(q[0])))
-            next_obs, reward, terminated, truncated, _ = self.env.step(a)
-            cols[SB.OBS].append(self._obs)
-            cols[SB.ACTIONS].append(a)
-            cols[SB.REWARDS].append(reward)
-            cols[NEXT_OBS].append(next_obs)
-            # a TRUNCATED episode is not terminal for bootstrapping
-            cols[SB.DONES].append(bool(terminated))
-            self._episode_reward += reward
-            if terminated or truncated:
-                self._episode_rewards.append(self._episode_reward)
-                self._obs, _ = self.env.reset()
-                self._episode_reward = 0.0
-            else:
-                self._obs = next_obs
-        batch = SampleBatch({
-            SB.OBS: np.asarray(cols[SB.OBS], np.float32),
-            SB.ACTIONS: np.asarray(cols[SB.ACTIONS], np.int32),
-            SB.REWARDS: np.asarray(cols[SB.REWARDS], np.float32),
-            NEXT_OBS: np.asarray(cols[NEXT_OBS], np.float32),
-            SB.DONES: np.asarray(cols[SB.DONES], np.bool_),
-        })
-        rewards, self._episode_rewards = self._episode_rewards, []
-        ref = put(dict(batch))
-        return [ref], len(batch), {"episode_rewards": rewards}
-
-
 class DQNLearner:
     """Jitted double-DQN update + periodic target sync."""
 
@@ -206,6 +141,7 @@ class DQNConfig:
     def __init__(self):
         self.env_creator: Optional[Callable] = None
         self.num_rollout_workers = 1
+        self.num_envs_per_worker = 1
         self.fragment_length = 128
         self.hidden = (64, 64)
         self.lr = 1e-3
@@ -225,12 +161,15 @@ class DQNConfig:
         return self
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
-                 rollout_fragment_length: Optional[int] = None
+                 rollout_fragment_length: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None
                  ) -> "DQNConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if rollout_fragment_length is not None:
             self.fragment_length = rollout_fragment_length
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
         return self
 
     def training(self, **kwargs) -> "DQNConfig":
@@ -264,9 +203,11 @@ class DQN:
             seed=config.seed)
         self.buffer = ReplayBuffer.remote(config.buffer_capacity,
                                           seed=config.seed)
+        from .vector_env import EnvRunner
         self.workers = [
-            _DQNRolloutWorker.remote(config.env_creator, module_config,
-                                     seed=config.seed + i)
+            EnvRunner.remote(config.env_creator, module_config,
+                             num_envs=config.num_envs_per_worker,
+                             module_kind="q", seed=config.seed + i * 1000)
             for i in range(config.num_rollout_workers)]
         self._steps_sampled = 0
         self._rng = np.random.default_rng(config.seed)
@@ -284,7 +225,8 @@ class DQN:
         t0 = time.perf_counter()
         weights = self.learner.get_weights()
         eps = self._epsilon()
-        outs = get([w.collect.remote(weights, c.fragment_length, eps)
+        outs = get([w.collect_epsilon_greedy.remote(
+                        weights, c.fragment_length, eps)
                     for w in self.workers])
         adds = []
         for wrapped, count, stats in outs:

@@ -20,7 +20,7 @@ from .. import get, wait
 from .env import CartPoleEnv
 from .learner import Learner, LearnerGroup
 from .module import DiscretePolicyModule
-from .rollout import RolloutWorker
+from .vector_env import EnvRunner
 from . import sample_batch as SB
 
 
@@ -30,6 +30,7 @@ class ImpalaConfig:
     def __init__(self):
         self.env_creator: Callable = CartPoleEnv
         self.num_rollout_workers = 2
+        self.num_envs_per_worker = 1
         self.rollout_fragment_length = 64
         self.lr = 5e-4
         self.gamma = 0.99
@@ -51,12 +52,15 @@ class ImpalaConfig:
         return self
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
-                 rollout_fragment_length: Optional[int] = None
+                 rollout_fragment_length: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None
                  ) -> "ImpalaConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
         return self
 
     def training(self, **kwargs) -> "ImpalaConfig":
@@ -96,9 +100,10 @@ class Impala:
         else:
             self.learner = Learner(self.module, **learner_kwargs)
         self.workers: List[Any] = [
-            RolloutWorker.remote(config.env_creator, module_cfg,
-                                 gamma=config.gamma, lam=1.0,
-                                 seed=config.seed + i)
+            EnvRunner.remote(config.env_creator, module_cfg,
+                             num_envs=config.num_envs_per_worker,
+                             gamma=config.gamma, lam=1.0,
+                             seed=config.seed + i * 1000)
             for i in range(config.num_rollout_workers)]
         # async pipeline: one sample request in flight per worker at all
         # times; train() consumes whatever is ready
@@ -130,27 +135,29 @@ class Impala:
         results = get(done_refs)
         finished_workers = [self._inflight.pop(r) for r in done_refs]
 
-        frags = [SB.SampleBatch(b) for b, _ in results]
+        # each runner reports [N, T, ...] fragments (N = envs/runner)
+        frags = [b for b, _ in results]
         stats_list = [s for _, s in results]
         boot_list = [s["bootstrap_obs"] for s in stats_list]
-        # pad B up to num_rollout_workers by cycling ready fragments:
-        # a constant batch shape keeps ONE compiled learner program
+        # pad B up to workers*envs by cycling ready fragments: a
+        # constant batch shape keeps ONE compiled learner program
         # instead of a retrace per distinct fragment count (slight
         # overweighting of duplicated rows, same spirit as the
         # reference's batch bucketing)
-        target_b = self.config.num_rollout_workers
+        target_b = (self.config.num_rollout_workers
+                    * self.config.num_envs_per_worker)
         i = 0
-        while len(frags) < target_b:
+        while sum(f[SB.OBS].shape[0] for f in frags) < target_b:
             frags.append(frags[i % len(results)])
             boot_list.append(boot_list[i % len(results)])
             i += 1
         batch = {
-            SB.OBS: np.stack([f[SB.OBS] for f in frags]),
-            SB.ACTIONS: np.stack([f[SB.ACTIONS] for f in frags]),
-            SB.REWARDS: np.stack([f[SB.REWARDS] for f in frags]),
-            SB.DONES: np.stack([f[SB.DONES] for f in frags]),
-            SB.LOGP: np.stack([f[SB.LOGP] for f in frags]),
-            "bootstrap_obs": np.stack(boot_list),
+            SB.OBS: np.concatenate([f[SB.OBS] for f in frags]),
+            SB.ACTIONS: np.concatenate([f[SB.ACTIONS] for f in frags]),
+            SB.REWARDS: np.concatenate([f[SB.REWARDS] for f in frags]),
+            SB.DONES: np.concatenate([f[SB.DONES] for f in frags]),
+            SB.LOGP: np.concatenate([f[SB.LOGP] for f in frags]),
+            "bootstrap_obs": np.concatenate(boot_list),
         }
         learner_stats: Dict[str, float] = {}
         for _ in range(self.config.num_sgd_iter):
@@ -169,7 +176,8 @@ class Impala:
         for w, s in zip(finished_workers, stats_list):
             self._episodes_by_worker[id(w)] = s["episodes_total"]
         self._episodes_total = sum(self._episodes_by_worker.values())
-        sampled = len(results) * self.config.rollout_fragment_length
+        sampled = (len(results) * self.config.num_envs_per_worker
+                   * self.config.rollout_fragment_length)
         return {
             "training_iteration": self.iteration,
             "episode_reward_mean": (float(np.mean(rewards)) if rewards

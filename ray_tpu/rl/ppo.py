@@ -16,7 +16,7 @@ from .. import get
 from .env import CartPoleEnv
 from .learner import Learner, LearnerGroup
 from .module import DiscretePolicyModule
-from .rollout import RolloutWorker
+from .vector_env import EnvRunner
 from .sample_batch import SampleBatch, concat_batches
 
 
@@ -26,6 +26,7 @@ class PPOConfig:
     def __init__(self):
         self.env_creator: Callable = CartPoleEnv
         self.num_rollout_workers = 2
+        self.num_envs_per_worker = 1
         self.rollout_fragment_length = 256
         self.num_sgd_iter = 8
         self.sgd_minibatch_size = 128
@@ -45,12 +46,15 @@ class PPOConfig:
         return self
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
-                 rollout_fragment_length: Optional[int] = None
+                 rollout_fragment_length: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None
                  ) -> "PPOConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
         return self
 
     def training(self, **kwargs) -> "PPOConfig":
@@ -88,9 +92,10 @@ class PPO:
         else:
             self.learner = Learner(self.module, **learner_kwargs)
         self.workers: List[Any] = [
-            RolloutWorker.remote(config.env_creator, module_cfg,
-                                 gamma=config.gamma, lam=config.lam,
-                                 seed=config.seed + i)
+            EnvRunner.remote(config.env_creator, module_cfg,
+                             num_envs=config.num_envs_per_worker,
+                             gamma=config.gamma, lam=config.lam,
+                             seed=config.seed + i * 1000)
             for i in range(config.num_rollout_workers)]
         self.iteration = 0
 
@@ -119,6 +124,7 @@ class PPO:
             "episodes_total": sum(s["episodes_total"]
                                   for s in stats_list),
             "num_env_steps_sampled": (cfg.rollout_fragment_length
+                                      * cfg.num_envs_per_worker
                                       * len(self.workers)),
             "time_this_iter_s": time.perf_counter() - t0,
             **{f"learner/{k}": v for k, v in sgd_stats.items()},

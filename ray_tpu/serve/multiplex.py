@@ -55,13 +55,15 @@ class _MultiplexCache:
             self._models[model_id] = model
             self._models.move_to_end(model_id)
             while len(self._models) > self._max:
-                old_id, old = self._models.popitem(last=False)
-                del_fn = getattr(old, "__del__", None)
-                if callable(del_fn):
+                _, old = self._models.popitem(last=False)
+                # cooperative unload hook; NOT __del__ (invoking a
+                # finalizer directly would run it again at GC time)
+                unload = getattr(old, "unload", None)
+                if callable(unload):
                     try:
-                        del_fn()
+                        unload()
                     except Exception:   # noqa: BLE001 — eviction is
-                        pass            # best-effort, like the reference
+                        pass            # best-effort
         return model
 
     def model_ids(self):

@@ -408,3 +408,53 @@ def test_burst_does_not_pile_on_one_node():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_versioned_heartbeat_drops_stale():
+    """RaySyncer-equivalent property: a delayed heartbeat frame with an
+    older version refreshes liveness but cannot roll the availability
+    view back (reference: ray_syncer.h:86)."""
+    from ray_tpu._private.gcs import GlobalControlPlane, NodeInfo
+    from ray_tpu._private.ids import NodeID
+
+    gcs = GlobalControlPlane()
+    nid = NodeID.from_random()
+    gcs.register_node(NodeInfo(node_id=nid, address="sock",
+                               resources_total={"CPU": 4.0}))
+    gcs.heartbeat(nid, {"CPU": 4.0}, version=10)
+    gcs.heartbeat(nid, {"CPU": 1.0}, version=12)
+    # delayed duplicate from the past: must not overwrite
+    gcs.heartbeat(nid, {"CPU": 4.0}, version=11)
+    info = gcs.get_node(nid)
+    assert info.resources_available == {"CPU": 1.0}
+    assert info.resource_version == 12
+    # delta ping (no payload) advances the version, keeps the view
+    gcs.heartbeat(nid, None, version=13)
+    info = gcs.get_node(nid)
+    assert info.resources_available == {"CPU": 1.0}
+    assert info.resource_version == 13
+    # newer payload applies
+    gcs.heartbeat(nid, {"CPU": 3.0}, version=14)
+    assert gcs.get_node(nid).resources_available == {"CPU": 3.0}
+
+
+def test_scheduling_with_delayed_heartbeats(tcp_cluster):
+    """Chaos: one node syncs its resource view 5x slower than the
+    default; a burst needing both nodes still completes, and the slow
+    node is never declared dead (VERDICT r04 ask #9)."""
+    tcp_cluster.add_node(num_cpus=2,
+                         env={"RTPU_HEARTBEAT_PERIOD_MS": "5000"})
+    _wait_for_nodes(2)
+
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.05)
+        return i
+
+    # 3 waves: routing decisions against the stale view must not wedge
+    for wave in range(3):
+        got = ray_tpu.get([work.remote(i) for i in range(12)],
+                          timeout=90)
+        assert sorted(got) == list(range(12))
+    alive = [x for x in ray_tpu.nodes() if x["alive"]]
+    assert len(alive) == 2          # slow heartbeats != dead
