@@ -239,13 +239,20 @@ class CoreClient:
     @staticmethod
     def _print_remote_logs(data: dict) -> None:
         """Worker output on the driver's stdout, prefixed like the
-        reference's ``(pid=..., ip=...)`` log prefixes."""
+        reference's ``(pid=..., ip=...)`` log prefixes. tqdm magic
+        lines render as in-place progress instead (reference:
+        ``experimental/tqdm_ray.py``)."""
         import sys as _sys
+
+        from ..util import tqdm_ray
         prefix = f"(worker {data.get('worker', '?')[:8]} " \
                  f"node={data.get('node_id', '?')[:8]})"
-        out = "".join(f"{prefix} {line}\n" for line in data.get("lines", ()))
-        _sys.stdout.write(out)
-        _sys.stdout.flush()
+        plain = [line for line in data.get("lines", ())
+                 if not tqdm_ray.render_magic_line(line)]
+        if plain:
+            out = "".join(f"{prefix} {line}\n" for line in plain)
+            _sys.stdout.write(out)
+            _sys.stdout.flush()
 
     def _fail_all(self, exc: Exception) -> None:
         # _req_lock orders this against _request: a request registered

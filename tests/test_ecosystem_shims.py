@@ -1,6 +1,8 @@
 """multiprocessing.Pool + joblib backend shims (reference analogues:
 ``python/ray/util/multiprocessing`` and ``python/ray/util/joblib``)."""
 
+import time
+
 import pytest
 
 import ray_tpu
@@ -77,3 +79,38 @@ def test_joblib_backend(rtpu_init):
     with joblib.parallel_backend("rtpu", n_jobs=4):
         out = Parallel()(delayed(_sq)(i) for i in range(12))
     assert out == [i * i for i in range(12)]
+
+
+def test_tqdm_ray_driver_and_worker(rtpu_init, capsys):
+    """tqdm shim (reference: experimental/tqdm_ray.py): bars work on
+    the driver, and worker bars ride the log channel as magic lines
+    that render in place instead of interleaving raw prints."""
+    from ray_tpu.util import tqdm_ray
+
+    # driver-side: iterate + manual update
+    out = list(tqdm_ray.tqdm(range(5), desc="drv"))
+    assert out == [0, 1, 2, 3, 4]
+    bar = tqdm_ray.tqdm(total=10, desc="manual")
+    bar.update(7)
+    assert bar.n == 7
+    bar.close()
+
+    # magic-line protocol: recognized lines render, others pass through
+    assert tqdm_ray.render_magic_line(
+        tqdm_ray.MAGIC + '{"id": "x", "n": 3, "total": 9, '
+        '"desc": "w", "closed": false}')
+    assert not tqdm_ray.render_magic_line("ordinary worker print")
+
+    # worker-side: the magic line must NOT appear as raw driver stdout
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util import tqdm_ray as tq
+        for _ in tq.tqdm(range(3), desc="wkr"):
+            pass
+        print("done-marker")
+        return True
+
+    assert ray_tpu.get(work.remote())
+    time.sleep(1.5)          # let the log tailer pump the lines
+    captured = capsys.readouterr()
+    assert tqdm_ray.MAGIC not in captured.out
