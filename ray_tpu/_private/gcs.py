@@ -763,6 +763,18 @@ class GlobalControlPlane:
         with self._lock:
             return list(self.jobs.values())
 
+    def gang_hosts(self) -> set:
+        """Nodes holding live placement-group bundles. A gang node is
+        never drainable while its PG exists — the reservation holds
+        resources whether or not tasks currently run (reference: PG
+        resources stay claimed until removal)."""
+        out = set()
+        with self._lock:
+            for rec in self.placement_groups.values():
+                if rec.get("state") == PG_CREATED:
+                    out.update(rec.get("assignment") or ())
+        return out
+
     def directory_snapshot(self) -> List[Tuple[ObjectID,
                                                Tuple[NodeID, ObjectMeta]]]:
         with self._lock:

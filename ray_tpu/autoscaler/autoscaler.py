@@ -79,9 +79,12 @@ class StandardAutoscaler:
                 traceback.print_exc(file=sys.stderr)
 
     # max age of a pending-PG record before it stops driving scale-up
-    # (the blocked client refreshes it every <=0.5s; older means the
-    # driver gave up or died)
-    PENDING_PG_STALE_S = 5.0
+    # (the blocked client refreshes it every <=0.5s when healthy; the
+    # margin is wide because a refresh that slips under host load must
+    # not drop the gang mid-launch — that drained half-launched gang
+    # nodes and churned the whole placement. A dead driver's record
+    # still expires, just later.)
+    PENDING_PG_STALE_S = 30.0
 
     # --------------------------------------------------------------- update
     def update(self) -> None:
@@ -254,6 +257,13 @@ class StandardAutoscaler:
                             or meta.arena_ref is not None}
         except Exception:
             object_hosts = set()
+        try:
+            # reservation state is authoritative at the GCS: a freshly
+            # reserved gang node can look idle until its next heartbeat
+            # lands, but must never drain while its PG lives
+            gang_hosts = self.gcs.gang_hosts()
+        except Exception:
+            gang_hosts = set()
         now = time.monotonic()
         for handle in self.provider.non_terminated_nodes():
             node_id = self.provider.node_id_of(handle)
@@ -264,7 +274,8 @@ class StandardAutoscaler:
             avail = info.resources_available or {}
             busy = any(total - avail.get(k, 0.0) > 1e-9
                        for k, total in info.resources_total.items())
-            if busy or info.pending_shapes or node_id in object_hosts:
+            if (busy or info.pending_shapes or node_id in object_hosts
+                    or node_id in gang_hosts):
                 self._idle_since.pop(key, None)
                 continue
             first = self._idle_since.setdefault(key, now)

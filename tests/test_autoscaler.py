@@ -118,7 +118,14 @@ def test_strict_spread_pg_scales_up_n_nodes(autoscaling_cluster):
     # head has no TPU: 3 distinct TPU nodes are needed
     pg = placement_group([{"TPU": 2.0}] * 3, strategy="STRICT_SPREAD")
     pg.ready(timeout=90)
+    # ready() can precede the provider's bookkeeping: a node serves the
+    # cluster as soon as it registers, while create_node is still
+    # finishing worker prestart — poll briefly
+    deadline = time.monotonic() + 30
     nodes = provider.non_terminated_nodes()
+    while len(nodes) < 3 and time.monotonic() < deadline:
+        time.sleep(0.2)
+        nodes = provider.non_terminated_nodes()
     assert len(nodes) == 3, f"expected 3 gang nodes, got {len(nodes)}"
     # bundles landed on distinct nodes
     assignment = pg._assignment

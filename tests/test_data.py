@@ -225,10 +225,14 @@ def test_from_generators_streams_blocks(rtpu_init):
     t_total = _time.time() - t0
     assert len(rest) == 11
     assert rest[-1]["x"][0] == 11
-    # streaming property, load-robust: the first block arrived well
-    # before the full 12x0.15s production run completed
-    assert t_first < 0.6 * t_total, \
-        f"first block at {t_first:.2f}s of {t_total:.2f}s total"
+    # streaming property, load-robust: after the first block arrives,
+    # the remaining 11 blocks still take most of their 1.65s production
+    # span to drain — batch delivery would hand them over instantly.
+    # (An absolute/ratio bound on t_first breaks when worker-spawn
+    # latency under load dominates the 1.8s production run.)
+    assert t_total - t_first > 0.8, \
+        f"blocks arrived as a batch: first at {t_first:.2f}s, " \
+        f"all by {t_total:.2f}s"
 
 
 def test_from_generators_with_stages(rtpu_init):
