@@ -613,3 +613,68 @@ class GroupedData:
     def std(self, on: str, ddof: int = 1) -> "Dataset":
         from .aggregate import Std
         return self.aggregate(Std(on, ddof))
+
+
+def _extend_dataset_conveniences():
+    """Column/row conveniences riding existing operators (reference:
+    ``Dataset.select_columns/drop_columns/add_column/rename_columns``
+    and the scalar ``sum/min/max/mean/std/unique`` reducers of
+    ``python/ray/data/dataset.py``)."""
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        cols = list(cols)
+        return self.map_batches(
+            lambda b: {k: b[k] for k in cols})
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in drop})
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(b):
+            out = dict(b)
+            out[name] = np.asarray(fn(b))
+            return out
+        return self.map_batches(add)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()})
+
+    def _scalar(self, agg):
+        return self.aggregate(agg)[agg.name]
+
+    def sum(self, on: str):
+        from .aggregate import Sum
+        return _scalar(self, Sum(on))
+
+    def min(self, on: str):
+        from .aggregate import Min
+        return _scalar(self, Min(on))
+
+    def max(self, on: str):
+        from .aggregate import Max
+        return _scalar(self, Max(on))
+
+    def mean(self, on: str):
+        from .aggregate import Mean
+        return _scalar(self, Mean(on))
+
+    def std(self, on: str, ddof: int = 1):
+        from .aggregate import Std
+        return _scalar(self, Std(on, ddof))
+
+    def unique(self, column: str) -> List[Any]:
+        parts = [np.unique(np.asarray(blk[column]))
+                 for blk in self.iter_blocks() if B.block_num_rows(blk)]
+        if not parts:
+            return []
+        return np.unique(np.concatenate(parts)).tolist()
+
+    for fn in (select_columns, drop_columns, add_column, rename_columns,
+               sum, min, max, mean, std, unique):
+        setattr(Dataset, fn.__name__, fn)
+
+
+_extend_dataset_conveniences()

@@ -247,3 +247,30 @@ def test_from_generators_with_stages(rtpu_init):
     got = sorted(v for blk in ds.iter_blocks() for v in blk["x"])
     expect = sorted(v * 10 for _ in range(2) for v in range(20))
     assert got == expect
+
+
+def test_dataset_column_conveniences(rtpu_init):
+    """select/drop/add/rename columns + scalar reducers + unique
+    (reference: python/ray/data/dataset.py surface)."""
+    ds = rd.from_numpy({"a": np.arange(100, dtype=np.int64),
+                        "b": np.arange(100, dtype=np.float64) / 10},
+                       num_blocks=4)
+    sel = ds.select_columns(["a"]).take(2)
+    assert set(sel[0]) == {"a"}
+    drp = ds.drop_columns(["a"]).take(1)
+    assert set(drp[0]) == {"b"}
+    add = ds.add_column("c", lambda b: b["a"] * 2).take(3)
+    assert [int(r["c"]) for r in add] == [0, 2, 4]
+    ren = ds.rename_columns({"a": "alpha"}).take(1)
+    assert set(ren[0]) == {"alpha", "b"}
+
+    assert int(ds.sum("a")) == 4950
+    assert int(ds.min("a")) == 0
+    assert int(ds.max("a")) == 99
+    assert ds.mean("b") == pytest.approx(np.arange(100).mean() / 10)
+    assert ds.std("b") == pytest.approx(
+        (np.arange(100) / 10).std(ddof=1), rel=1e-6)
+
+    small = rd.from_items([{"k": v} for v in [3, 1, 2, 1, 3]],
+                          num_blocks=2)
+    assert small.unique("k") == [1, 2, 3]
