@@ -37,6 +37,9 @@ from .read_api import (  # noqa: F401
     read_text,
     read_tfrecords,
 )
+from .write_api import install_writers as _install_writers
+_install_writers(Dataset)
+del _install_writers
 from .datasource import (  # noqa: F401
     BinaryDatasource,
     CSVDatasource,
