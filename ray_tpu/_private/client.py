@@ -364,19 +364,14 @@ class CoreClient:
             self._wire_put(oid, *self._serialize_flat(value))
             return ObjectRef(oid)
         meta = self._store_value(oid, value)
-        if meta.shm_name is not None:
-            # Dedicated-segment object: block until the node store adopts
-            # it, so the store's budget accounting (and spilling) stays
-            # ahead of the writer — matches the reference, where
-            # ``ray.put`` returns only after the plasma seal
-            # (``core_worker.cc:1141``).
+        if meta.shm_name is not None or meta.arena_ref is not None:
+            # Large object: block until the node store adopts it — a
+            # returned ref IS sealed, matching the reference
+            # (``core_worker.cc:1141``). A one-way seal was measured at
+            # <3% on the put bench and let a returned ref race the
+            # store's visibility/accounting; not worth the drift.
             self._sync_put(meta)
         else:
-            # Inline or arena-backed: the arena slot was charged against
-            # the store budget at ALLOC_OBJECT, so the seal can be
-            # one-way — same-socket frame order keeps it ahead of any
-            # later get()/free() from this client (saves one blocking
-            # round trip per large put)
             self._send(P.PUT_OBJECT, meta)
         return ObjectRef(oid)
 

@@ -22,7 +22,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -438,6 +438,12 @@ class NodeService:
         # Subtracted from _candidates so a burst doesn't pile onto one
         # node through a stale view (RaySyncer-staleness bridge).
         self._route_debits: Dict[NodeID, List[Tuple[float, Dict[str, float]]]] = {}
+        # where each task WE submitted ran, outliving the _owned entry
+        # (popped at completion): the read path probes this node's
+        # store before asking the head's directory (owner-based
+        # location resolution, reference:
+        # ownership_based_object_directory.h). Bounded FIFO.
+        self._task_origin: "OrderedDict[TaskID, NodeID]" = OrderedDict()
         self._waiting_deps: Dict[TaskID, _TaskRecord] = {}
         self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
         self._running: Dict[TaskID, _TaskRecord] = {}
@@ -1371,6 +1377,7 @@ class NodeService:
             return
         if owned:
             owned.assigned_node = target
+            self._record_task_origin(spec.task_id, target)
         # a starved target spills the task back here for re-routing
         spec.origin_node_id = self.node_id.binary()
         if target == self.node_id:
@@ -1536,6 +1543,22 @@ class NodeService:
         never pulls a cross-host payload (that happens at read time)."""
         if self.store.contains(oid):
             return True
+        tid = TaskID(TaskID.KIND + oid.binary()[:15])
+        owned = self._owned.get(tid)
+        if owned is not None and not owned.done:
+            # our own still-running task: its returns exist nowhere yet
+            # — park without a head directory round trip (owner-based
+            # resolution; the completion event resolves the waiter)
+            return False
+        origin = self._task_origin.get(tid)
+        if origin is not None and origin != self.node_id:
+            remote = self._peer_store(origin)
+            if remote is not None and remote is not self.store:
+                try:
+                    if remote.get_meta(oid) is not None:
+                        return True
+                except Exception:   # noqa: BLE001 — head fallback below
+                    pass
         loc = self.gcs.lookup_location(oid)
         if loc is None:
             return False
@@ -1548,10 +1571,33 @@ class NodeService:
             return handle.peek(oid) is not None
         return handle.get_meta(oid) is not None
 
+    def _record_task_origin(self, task_id: TaskID, node_id: NodeID
+                            ) -> None:
+        self._task_origin[task_id] = node_id
+        self._task_origin.move_to_end(task_id)
+        while len(self._task_origin) > 8192:
+            self._task_origin.popitem(last=False)
+
     def _lookup_object(self, oid: ObjectID) -> Optional[ObjectMeta]:
         meta = self.store.get_meta(oid)
         if meta is not None:
             return meta
+        # owner-based resolution first (reference:
+        # ownership_based_object_directory.h): we submitted the creating
+        # task, so we know which node sealed its returns — read straight
+        # from that store, no head directory RTT. Miss (freed, moved,
+        # reconstructed elsewhere) falls back to the head.
+        origin = self._task_origin.get(
+            TaskID(TaskID.KIND + oid.binary()[:15]))
+        if origin is not None and origin != self.node_id:
+            remote = self._peer_store(origin)
+            if remote is not None and remote is not self.store:
+                try:
+                    meta = remote.get_meta(oid)
+                except Exception:   # noqa: BLE001 — peer gone; head
+                    meta = None     # fallback resolves or fails cleanly
+                if meta is not None:
+                    return meta
         loc = self.gcs.lookup_location(oid)
         if loc is None:
             return None
@@ -2599,6 +2645,8 @@ class NodeService:
             return
         owned = self._owned[spec.task_id]
         owned.assigned_node = rec.node_id
+        if rec.node_id is not None:
+            self._record_task_origin(spec.task_id, rec.node_id)
         if rec.node_id == self.node_id or rec.node_id is None:
             self._local_actor_task(spec)
         else:
