@@ -25,3 +25,8 @@ from .sample_batch import SampleBatch, concat_batches  # noqa: F401
 from .dqn import DQN, DQNConfig, ReplayBuffer  # noqa: F401
 from .module import QNetworkModule  # noqa: F401
 from .vector_env import EnvRunner, VectorEnv  # noqa: F401
+from .replay_buffers import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer as UniformReplayBuffer,
+)
+from .offline import OfflineDQN, collect_to_dataset  # noqa: F401

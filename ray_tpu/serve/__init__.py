@@ -18,9 +18,12 @@ from .api import (  # noqa: F401
     run,
     shutdown,
     start,
+    start_grpc,
     start_http,
+    stop_grpc,
     stop_http,
 )
+from .grpc_ingress import grpc_call, grpc_stream  # noqa: F401
 from .batching import batch  # noqa: F401
 from .handle import DeploymentHandle  # noqa: F401
 from .multiplex import (  # noqa: F401

@@ -109,6 +109,7 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     stop_http()
+    stop_grpc()
     try:
         from .proxy import stop_proxies
         stop_proxies()
@@ -153,20 +154,25 @@ class _GatewayHandler:
             self._routes_at = now
         return self._routes_cache
 
-    def call(self, name: str, arg):
+    def _handle(self, name: str):
         handle = self._handles.get(name)
         if handle is None:
             handle = get_deployment_handle(name)
             self._handles[name] = handle
+        return handle
+
+    def call(self, name: str, arg, model_id: Optional[str] = None):
+        handle = self._handle(name)
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         return handle.remote(arg).result(timeout=30.0)
 
-    def stream(self, name: str, arg):
+    def stream(self, name: str, arg, model_id: Optional[str] = None):
         """Iterator of item values from a streaming deployment handler
         (generator)."""
-        handle = self._handles.get(name)
-        if handle is None:
-            handle = get_deployment_handle(name)
-            self._handles[name] = handle
+        handle = self._handle(name)
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         return handle.stream(arg)
 
 
@@ -281,6 +287,26 @@ def start(*, proxy_location: str = "HeadOnly",
     raise ValueError(
         f"proxy_location must be 'HeadOnly' or 'EveryNode', "
         f"got {proxy_location!r}")
+
+
+_grpc_server = None
+
+
+def start_grpc(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Start the gRPC ingress (reference: serve's gRPCProxy); returns
+    "host:port". See ``serve/grpc_ingress.py`` for the wire contract."""
+    global _grpc_server
+    stop_grpc()
+    from .grpc_ingress import start_grpc as _start
+    _grpc_server, addr = _start(host, port)
+    return addr
+
+
+def stop_grpc() -> None:
+    global _grpc_server
+    if _grpc_server is not None:
+        _grpc_server.stop(grace=None)
+        _grpc_server = None
 
 
 def stop_http() -> None:

@@ -359,3 +359,47 @@ def test_multiplexed_streaming(serve_session):
     items = list(handle.options(multiplexed_model_id="mx").stream(2))
     assert items == [{"eager": "mx", "lazy": "mx"}] * 2, items
     serve.delete("S")
+
+
+def test_grpc_ingress_call_stream_and_multiplex(serve_session):
+    """gRPC ingress (reference: serve gRPCProxy): unary call, server
+    streaming with mid-stream error frames, multiplexed model id
+    propagation, unknown-deployment errors."""
+
+    @serve.deployment(num_replicas=1)
+    class G:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, mid):
+            return f"M{mid}"
+
+        def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            if isinstance(x, dict) and x.get("stream"):
+                def gen():
+                    for i in range(int(x["stream"])):
+                        if x.get("boom") and i == 1:
+                            raise ValueError("mid-stream boom")
+                        yield {"i": i, "m": self.get_model(mid) if mid
+                               else None}
+                return gen()
+            return {"x": x, "m": self.get_model(mid) if mid else None}
+
+    serve.run(G.bind())
+    addr = serve.start_grpc()
+
+    out = serve.grpc_call(addr, "G", {"v": 1})
+    assert out == {"result": {"x": {"v": 1}, "m": None}}
+    out = serve.grpc_call(addr, "G", 5, multiplexed_model_id="a")
+    assert out["result"]["m"] == "Ma"
+    out = serve.grpc_call(addr, "Nope", 1)
+    assert "error" in out
+
+    frames = list(serve.grpc_stream(addr, "G", {"stream": 3},
+                                    multiplexed_model_id="b"))
+    assert frames == [{"item": {"i": i, "m": "Mb"}} for i in range(3)]
+    frames = list(serve.grpc_stream(addr, "G",
+                                    {"stream": 3, "boom": True}))
+    assert frames[0] == {"item": {"i": 0, "m": None}}
+    assert "error" in frames[-1]
+    serve.stop_grpc()
+    serve.delete("G")
