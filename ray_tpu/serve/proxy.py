@@ -25,11 +25,20 @@ class ProxyActor:
     """One node's HTTP ingress. Runs the gateway HTTP server in this
     actor's process; the bound address is queryable."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 grpc_port: Optional[int] = 0):
         import socket
 
         from .api import _gateway_server
         self._server, self._addr = _gateway_server(host, port)
+        # gRPC side-by-side (reference: proxies serve both protocols);
+        # None disables it
+        self._grpc_server = None
+        self._grpc_addr = None
+        if grpc_port is not None:
+            from .grpc_ingress import start_grpc
+            self._grpc_server, self._grpc_addr = start_grpc(host,
+                                                            grpc_port)
         if host == "0.0.0.0":
             # a wildcard bind is not a connectable URL; advertise this
             # node's resolvable address instead (multi-host ingress —
@@ -43,11 +52,16 @@ class ProxyActor:
     def address(self) -> str:
         return self._addr
 
+    def grpc_address(self) -> Optional[str]:
+        return self._grpc_addr
+
     def ready(self) -> bool:
         return True
 
     def stop(self) -> None:
         self._server.stop()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=None)
 
 
 def _alive_nodes() -> List[dict]:

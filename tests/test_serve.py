@@ -403,3 +403,31 @@ def test_grpc_ingress_call_stream_and_multiplex(serve_session):
     assert "error" in frames[-1]
     serve.stop_grpc()
     serve.delete("G")
+
+
+def test_proxy_grpc_on_every_node(rtpu_cluster):
+    """Per-node proxies serve gRPC alongside HTTP (reference: the
+    proxy actor hosts both protocol frontends)."""
+    rtpu_cluster.add_node(num_cpus=2)
+
+    try:
+        @serve.deployment(num_replicas=1)
+        def triple(x):
+            return {"tripled": (x or {"v": 0})["v"] * 3}
+
+        serve.run(triple.bind())
+        serve.start(proxy_location="EveryNode")
+        from ray_tpu import get, get_actor
+        from ray_tpu.serve.proxy import _PROXY_PREFIX, _alive_nodes
+
+        grpc_addrs = []
+        for node in _alive_nodes():
+            proxy = get_actor(_PROXY_PREFIX + node["node_id"].hex())
+            grpc_addrs.append(get(proxy.grpc_address.remote(),
+                                  timeout=30))
+        assert len(grpc_addrs) == 2 and all(grpc_addrs)
+        for addr in grpc_addrs:
+            out = serve.grpc_call(addr, "triple", {"v": 14})
+            assert out == {"result": {"tripled": 42}}, (addr, out)
+    finally:
+        serve.shutdown()
