@@ -431,3 +431,30 @@ def test_proxy_grpc_on_every_node(rtpu_cluster):
             assert out == {"result": {"tripled": 42}}, (addr, out)
     finally:
         serve.shutdown()
+
+
+def test_proxy_recreated_after_death(rtpu_cluster):
+    """ensure_proxies is a reconciler: a dead proxy actor is replaced
+    on the next start() (reference: ProxyStateManager restarts
+    unhealthy proxies)."""
+    import urllib.request
+
+    try:
+        @serve.deployment(num_replicas=1)
+        def ping(x):
+            return {"pong": True}
+
+        serve.run(ping.bind())
+        addrs = serve.start(proxy_location="EveryNode")
+        (node_hex,) = list(addrs)
+        from ray_tpu import get_actor, kill
+        from ray_tpu.serve.proxy import _PROXY_PREFIX
+        kill(get_actor(_PROXY_PREFIX + node_hex))
+        time.sleep(0.5)
+        addrs2 = serve.start(proxy_location="EveryNode")
+        assert node_hex in addrs2
+        with urllib.request.urlopen(f"{addrs2[node_hex]}/ping",
+                                    timeout=30) as resp:
+            assert resp.status == 200
+    finally:
+        serve.shutdown()
