@@ -215,6 +215,10 @@ def shutdown() -> None:
     _global_gcs = None
     _session_dir = None
     _owns_cluster = False
+    # telemetry is session-scoped too: the next init() gets a fresh
+    # control plane, so local shard totals must not leak deltas into it
+    from ._private import telemetry as _telemetry
+    _telemetry.reset()
     # _system_config is session-scoped: the next init() must not inherit
     # this session's overrides (they'd silently change its behavior)
     CONFIG.reload()

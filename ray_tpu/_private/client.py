@@ -359,11 +359,23 @@ class CoreClient:
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
+        from .object_ref import begin_ref_capture, end_ref_capture
         oid = ObjectID.for_put(self.worker_id)
+        # the ref exists (and is registered) BEFORE any contained-ref
+        # pin references it as holder — see _pin_contained below
+        ref = ObjectRef(oid)
+        begin_ref_capture()
+        try:
+            if self.wire_data_plane:
+                flat = self._serialize_flat(value)
+            else:
+                meta = self._store_value(oid, value)
+        finally:
+            contained = end_ref_capture()
+        self._pin_contained(oid, contained)
         if self.wire_data_plane:
-            self._wire_put(oid, *self._serialize_flat(value))
-            return ObjectRef(oid)
-        meta = self._store_value(oid, value)
+            self._wire_put(oid, *flat)
+            return ref
         if meta.shm_name is not None or meta.arena_ref is not None:
             # Large object: block until the node store adopts it — a
             # returned ref IS sealed, matching the reference
@@ -373,7 +385,19 @@ class CoreClient:
             self._sync_put(meta)
         else:
             self._send(P.PUT_OBJECT, meta)
-        return ObjectRef(oid)
+        return ref
+
+    def _pin_contained(self, oid: ObjectID, contained: list) -> None:
+        """Refs pickled INSIDE a stored value would lose their last
+        holder once the caller's own refs die (same deadlock class as
+        refs nested in task returns): ship the containment edge so the
+        plane pins them until the container is freed. flush_refs first
+        so our REGISTER of the container reaches the plane before the
+        pin checks for a live holder."""
+        if not contained:
+            return
+        self.flush_refs()
+        self._send(P.RETURN_REFS, (oid, contained))
 
     def _sync_put(self, meta: ObjectMeta) -> None:
         """Acked put of a shm-backed object; unlinks the segment if the
@@ -571,9 +595,14 @@ class CoreClient:
         return packed, pkw
 
     def _pack_one(self, value: Any) -> Tuple[str, Any]:
+        from .object_ref import begin_ref_capture, end_ref_capture
         if isinstance(value, ObjectRef):
             return ("r", value.id)
-        smeta, views = ser.serialize(value)
+        begin_ref_capture()
+        try:
+            smeta, views = ser.serialize(value)
+        finally:
+            contained = end_ref_capture()
         total = ser.serialized_size(smeta, views)
         if total <= CONFIG.max_inline_object_bytes:
             out = bytearray(total)
@@ -583,12 +612,14 @@ class CoreClient:
         # the same reason as put(): the store's budget accounting must not
         # lag behind a writer looping over f.remote(big_array).
         oid = ObjectID.for_put(self.worker_id)
+        implicit_ref = ObjectRef(oid)       # holder for _pin_contained
+        self._pin_contained(oid, contained)
         if self.wire_data_plane:
             self._wire_put(oid, _flat_bytes(smeta, views, total), total)
-            return ("r", oid)
+            return ("r", implicit_ref.id)
         meta = self.store_large(oid, smeta, views, total)
         self._sync_put(meta)
-        return ("r", oid)
+        return ("r", implicit_ref.id)
 
     # ---------------------------------------------------------------- tasks
     def ensure_function(self, function_id: bytes, blob_fn) -> None:

@@ -125,7 +125,24 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                         "with cross-process context propagation "
                         "(reference: ray.util.tracing)"),
     "span_buffer_size": (int, 20000, "ring buffer of finished spans"),
-    "metrics_report_interval_ms": (int, 5000, "metrics flush period"),
+    "metrics_report_interval_ms": (int, 1000,
+                                   "telemetry delta-flush period (the "
+                                   "background flusher; task completions "
+                                   "flush rate-limited, exports flush "
+                                   "synchronously)"),
+    "telemetry_enabled": (bool, True,
+                          "record runtime metrics (in-process shards + "
+                          "batched delta push; reference: the per-node "
+                          "MetricsAgent pipeline). Off = every record "
+                          "call returns immediately"),
+    "telemetry_sample_interval_ms": (int, 2000,
+                                     "per-node host/device sampler period "
+                                     "(RSS, store fill, HBM via "
+                                     "device.memory_stats())"),
+    "metric_series_limit": (int, 10000,
+                            "max distinct (name, tags) series the control "
+                            "plane keeps; excess series are dropped and "
+                            "counted"),
     # --- protocol ---
     "rpc_inline_chunk_bytes": (int, 1 << 20, "frame chunking for large messages"),
     "object_transfer_chunk_bytes": (int, 8 << 20,

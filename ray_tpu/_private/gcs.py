@@ -168,6 +168,18 @@ class GlobalControlPlane:
         self.cluster_events: deque = deque(
             maxlen=CONFIG.cluster_events_buffer_size)
         self.spans: deque = deque(maxlen=CONFIG.span_buffer_size)
+        # cluster-wide metrics table: merged deltas from every process's
+        # telemetry shards (reference analogue: the head's Prometheus
+        # scrape target aggregating per-node MetricsAgents)
+        self.metrics_counters: Dict[tuple, float] = {}
+        self.metrics_gauges: Dict[tuple, tuple] = {}      # key -> (val, ts)
+        self.metrics_hists: Dict[tuple, dict] = {}
+        self.metrics_meta: Dict[str, dict] = {}
+        # distinct series refused (cardinality cap) / bucket-conflicted:
+        # sets, not event counters — every flush retries the same key
+        # and must not inflate the count
+        self._metrics_dropped_keys: set = set()
+        self._metrics_conflict_keys: set = set()
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
         # distributed reference counting (reference: reference_count.h:61):
         # holder = (node_id_bin, conn_key) — one entry per process holding
@@ -180,6 +192,11 @@ class GlobalControlPlane:
         # returns whose refs all died BEFORE the task sealed them: the
         # seal must free them immediately (fire-and-forget tasks)
         self._freed_early: set = set()
+        # refs pickled INSIDE a return object (worker RETURN_REFS):
+        # pinned until the return itself is freed, so a nested ref's
+        # object survives the gap between the producer's locals dying
+        # and a consumer deserializing the return
+        self._contained_pins: Dict[ObjectID, List[ObjectID]] = {}
         # zero-count objects in their free-grace window (oid -> deadline;
         # see _schedule_zero_locked)
         self._zero_pending: Dict[ObjectID, float] = {}
@@ -483,6 +500,11 @@ class GlobalControlPlane:
     def drop_location(self, object_id: ObjectID) -> None:
         with self._lock:
             self.directory.pop(object_id, None)
+            # explicit free (ray_tpu free()) of a return releases its
+            # nested-ref pins; the refcount zero path already popped
+            # them in _zero_check, so this is a no-op there
+            self._release_contained_locked(object_id)
+        self.sweep_ref_zeros()
 
     # ------------------------------------------------- pending gangs
     # Placement groups that could not be packed onto the live cluster.
@@ -682,6 +704,32 @@ class GlobalControlPlane:
                 self.ref_pins[oid] = n
             self._schedule_zero_locked(oid)
 
+    def pin_contained(self, holder_oid: ObjectID,
+                      oids: List[ObjectID]) -> None:
+        """A task return carries these refs inside its payload: keep
+        their objects alive until the return object is freed. A repeat
+        for the same return (task retry) replaces the previous pin set."""
+        with self._lock:
+            if self.ref_holders.get(holder_oid) is None:
+                # the return's refs already died (fire-and-forget that
+                # dropped before seal): nothing can ever read it, so the
+                # nested objects are garbage too — don't pin
+                return
+            self._release_contained_locked(holder_oid)
+            self._contained_pins[holder_oid] = list(oids)
+            for oid in oids:
+                self.ref_pins[oid] = self.ref_pins.get(oid, 0) + 1
+                self._zero_pending.pop(oid, None)
+
+    def _release_contained_locked(self, holder_oid: ObjectID) -> None:
+        for oid in self._contained_pins.pop(holder_oid, ()):
+            n = self.ref_pins.get(oid, 1) - 1
+            if n <= 0:
+                self.ref_pins.pop(oid, None)
+                self._schedule_zero_locked(oid)
+            else:
+                self.ref_pins[oid] = n
+
     def _zero_check(self, oid: ObjectID):
         """Callers hold _lock. Returns a REF_ZERO payload when the object
         became garbage: it was tracked, no process holds a ref, and no
@@ -690,6 +738,9 @@ class GlobalControlPlane:
         if holders is None or holders or self.ref_pins.get(oid, 0) > 0:
             return None
         del self.ref_holders[oid]
+        # nested refs this return carried die with it (cascading via
+        # their own zero-grace)
+        self._release_contained_locked(oid)
         spec = self.lineage.pop(oid, None)
         if spec is not None:
             # spec cost was charged once for all returns: release it when
@@ -809,6 +860,76 @@ class GlobalControlPlane:
     def list_spans(self, limit: int = 10000) -> List[dict]:
         with self._lock:
             return list(self.spans)[-limit:]
+
+    # ------------------------------------------------------------ metrics
+    def _metric_series_ok(self, table: dict, key: tuple) -> bool:
+        """Series-cardinality cap: a runaway tag (e.g. a per-request id)
+        must not grow the head without bound."""
+        if key in table:
+            return True
+        if (len(self.metrics_counters) + len(self.metrics_gauges)
+                + len(self.metrics_hists)) >= CONFIG.metric_series_limit:
+            self._metrics_dropped_keys.add(key)
+            return False
+        return True
+
+    def record_metrics(self, payload: dict) -> None:
+        """Merge one process's telemetry deltas (counters += delta,
+        gauges latest-timestamp-wins, histogram buckets elementwise)."""
+        with self._lock:
+            for name, m in (payload.get("meta") or {}).items():
+                existing = self.metrics_meta.get(name)
+                if existing is None:
+                    self.metrics_meta[name] = dict(m)
+                elif m.get("description") and not existing.get("description"):
+                    existing["description"] = m["description"]
+            for key, delta in (payload.get("counters") or {}).items():
+                if self._metric_series_ok(self.metrics_counters, key):
+                    self.metrics_counters[key] = (
+                        self.metrics_counters.get(key, 0.0) + delta)
+            for key, vt in (payload.get("gauges") or {}).items():
+                if not self._metric_series_ok(self.metrics_gauges, key):
+                    continue
+                old = self.metrics_gauges.get(key)
+                if old is None or vt[1] >= old[1]:
+                    self.metrics_gauges[key] = tuple(vt)
+            for key, h in (payload.get("hists") or {}).items():
+                if not self._metric_series_ok(self.metrics_hists, key):
+                    continue
+                cur = self.metrics_hists.get(key)
+                if cur is None:
+                    self.metrics_hists[key] = {
+                        "buckets": tuple(h["buckets"]),
+                        "counts": list(h["counts"]),
+                        "sum": float(h["sum"]), "count": int(h["count"]),
+                        "exemplar": h.get("exemplar")}
+                elif cur["buckets"] == tuple(h["buckets"]):
+                    cur["counts"] = [a + b for a, b in
+                                     zip(cur["counts"], h["counts"])]
+                    cur["sum"] += h["sum"]
+                    cur["count"] += h["count"]
+                    if h.get("exemplar") is not None:
+                        cur["exemplar"] = h["exemplar"]
+                else:
+                    # same name+tags, different boundaries: buckets can't
+                    # merge — keep the first layout, fold into sum/count
+                    # so totals stay right, and count the conflict
+                    cur["sum"] += h["sum"]
+                    cur["count"] += h["count"]
+                    cur["counts"][-1] += int(h["count"])
+                    self._metrics_conflict_keys.add(key)
+
+    def metrics_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self.metrics_counters),
+                "gauges": dict(self.metrics_gauges),
+                "hists": {k: {**v, "counts": list(v["counts"])}
+                          for k, v in self.metrics_hists.items()},
+                "meta": {k: dict(v) for k, v in self.metrics_meta.items()},
+                "dropped_series": (len(self._metrics_dropped_keys)
+                                   + len(self._metrics_conflict_keys)),
+            }
 
     # ------------------------------------------------------------- pubsub
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:

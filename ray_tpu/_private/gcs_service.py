@@ -37,9 +37,11 @@ _ALLOWED = frozenset({
     "remove_pg", "record_task_event", "list_task_events", "publish",
     "actors_snapshot", "directory_snapshot", "pgs_snapshot", "jobs_snapshot",
     "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
-    "unpin_task_args", "record_lineage", "get_lineage", "claim_lineage",
+    "unpin_task_args", "pin_contained", "record_lineage", "get_lineage",
+    "claim_lineage",
     "record_cluster_event", "list_cluster_events",
-    "record_spans", "list_spans", "claim_actor_reroute",
+    "record_spans", "list_spans", "record_metrics", "metrics_snapshot",
+    "claim_actor_reroute",
     "requeue_actor_reroute",
     "gen_update", "gen_done", "gen_consumed", "gen_get", "gen_drop",
     "register_pending_pg", "clear_pending_pg", "pending_pgs_snapshot",
@@ -197,8 +199,8 @@ class RemoteControlPlane:
         "heartbeat", "publish_location", "drop_location",
         "record_task_event", "publish", "kv_del", "finish_job",
         "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
-        "unpin_task_args", "record_lineage",
-        "record_cluster_event", "record_spans",
+        "unpin_task_args", "pin_contained", "record_lineage",
+        "record_cluster_event", "record_spans", "record_metrics",
         "gen_update", "gen_done", "gen_consumed", "gen_drop",
         "register_pending_pg", "clear_pending_pg",
     })
@@ -233,10 +235,25 @@ class RemoteControlPlane:
                 pass
 
     def _call(self, method: str, *args, **kwargs) -> Any:
-        return self._rpc.request(
-            P.GCS_CALL, lambda rid: (rid, method, args, kwargs))
+        from . import telemetry
+        t0 = time.monotonic()
+        try:
+            return self._rpc.request(
+                P.GCS_CALL, lambda rid: (rid, method, args, kwargs))
+        finally:
+            telemetry.counter_inc(telemetry.M_GCS_RPC_TOTAL,
+                                  tags=(("kind", "call"),
+                                        ("method", method)))
+            telemetry.hist_observe(telemetry.M_GCS_RPC_LATENCY,
+                                   time.monotonic() - t0,
+                                   tags=(("method", method),))
 
     def _cast(self, method: str, *args, **kwargs) -> None:
+        from . import telemetry
+        if method != "record_metrics":     # the flush frame itself
+            telemetry.counter_inc(telemetry.M_GCS_RPC_TOTAL,
+                                  tags=(("kind", "cast"),
+                                        ("method", method)))
         self._rpc.send(P.GCS_CAST, (method, args, kwargs))
 
     def __getattr__(self, name: str):

@@ -31,6 +31,7 @@ from . import events
 from . import memory_monitor
 from . import protocol as P
 from . import scheduler as sched
+from . import telemetry
 from .config import CONFIG
 from .gcs import (ACTOR_ALIVE, ACTOR_DEAD, ACTOR_PENDING, ACTOR_RESTARTING,
                   GlobalControlPlane, NodeInfo, PG_LOST, TaskEvent)
@@ -525,6 +526,10 @@ class NodeService:
 
         self._rng = random.Random(self.node_id.binary())
 
+        # pre-built telemetry tag tuple: the record path is hot (every
+        # submit/dispatch/seal), so the tags must not be rebuilt per call
+        self._mtags = (("node", self.node_id.hex()[:12]),)
+
     # ----------------------------------------------------------- lifecycle
     def start(self, labels: Optional[Dict[str, str]] = None,
               tcp_port: Optional[int] = None,
@@ -605,6 +610,7 @@ class NodeService:
                            CONFIG.maximum_startup_concurrency - 2))
         for _ in range(n_pre):
             self._spawn_worker()
+        telemetry.attach_node(self)
         self.events.info("NODE_START", "node service started",
                          resources=dict(self.resources_total),
                          address=self.tcp_address or self.socket_path)
@@ -614,6 +620,7 @@ class NodeService:
             return
         self._stopped.set()
         self.dead = True
+        telemetry.detach_node(self)
         try:
             self.gcs.remove_node(self.node_id, reason="node stopped")
         except Exception:   # remote GCS may already be gone
@@ -1174,6 +1181,11 @@ class NodeService:
                     self.gcs.record_spans(ev_payload)
                 except Exception:   # noqa: BLE001 — tracing is best-effort
                     pass
+            elif ev_kind == "metrics":
+                try:
+                    self.gcs.record_metrics(ev_payload)
+                except Exception:   # noqa: BLE001 — telemetry best-effort
+                    pass
         elif op == P.GET_OBJECTS:
             self._get_objects(key, *payload)
         elif op == P.GET_OBJECTS_FETCH:
@@ -1242,6 +1254,12 @@ class NodeService:
         elif op == P.REF_BATCH:
             for edge_op, oid in payload:
                 self._apply_ref_edge(key, edge_op, oid)
+        elif op == P.RETURN_REFS:
+            holder_oid, contained = payload
+            try:
+                self.gcs.pin_contained(holder_oid, contained)
+            except Exception:   # noqa: BLE001 — best-effort, like edges
+                pass
 
     def _reply(self, conn_key: int, op: int, payload: Any) -> None:
         conn = self._conns.get(conn_key)
@@ -1352,6 +1370,7 @@ class NodeService:
             pass
 
     def _submit_task(self, spec: P.TaskSpec) -> None:
+        telemetry.counter_inc(telemetry.M_TASKS_SUBMITTED, 1.0, self._mtags)
         self._owned[spec.task_id] = _OwnedTask(
             spec=spec, kind="task", retries_left=spec.max_retries)
         self._pin_submission(spec.task_id, self._arg_refs(spec), spec)
@@ -2197,6 +2216,9 @@ class NodeService:
         return wid
 
     def _assign(self, rec: _TaskRecord, wid: WorkerID) -> None:
+        telemetry.counter_inc(telemetry.M_TASKS_DISPATCHED, 1.0, self._mtags)
+        telemetry.hist_observe(telemetry.M_QUEUE_WAIT,
+                               time.monotonic() - rec.queued_at, self._mtags)
         w = self._workers[wid]
         w.state = "ACTOR" if rec.kind == "actor_create" else "BUSY"
         w.task = rec
@@ -2241,6 +2263,9 @@ class NodeService:
         if rec is None:
             return
         self._record_event(rec.spec, "FINISHED" if error is None else "FAILED")
+        telemetry.counter_inc(
+            telemetry.M_TASKS_FINISHED, 1.0,
+            self._mtags + (("status", "ok" if error is None else "error"),))
         self.gcs.publish("TASK_FINISHED", {"task_id": task_id,
                                            "ok": error is None})
         w = self._workers.get(rec.worker_id) if rec.worker_id else None
@@ -2268,6 +2293,9 @@ class NodeService:
 
     def _seal_object(self, meta: ObjectMeta) -> None:
         self.store.adopt(meta)
+        telemetry.counter_inc(telemetry.M_STORE_PUTS, 1.0, self._mtags)
+        telemetry.counter_inc(telemetry.M_STORE_PUT_BYTES,
+                              float(meta.size), self._mtags)
         self.gcs.publish_location(meta.object_id, self.node_id, meta)
         self.gcs.publish("OBJECT", (meta.object_id, meta))
 
@@ -2628,6 +2656,7 @@ class NodeService:
         self._flush_actor_queue(spec.actor_id)
 
     def _submit_actor_task(self, spec: P.TaskSpec) -> None:
+        telemetry.counter_inc(telemetry.M_TASKS_SUBMITTED, 1.0, self._mtags)
         self._owned[spec.task_id] = _OwnedTask(
             spec=spec, kind="actor_call", retries_left=spec.max_retries)
         self._pin_submission(spec.task_id, self._arg_refs(spec))
@@ -2959,6 +2988,14 @@ class NodeService:
             if not self._object_exists(oid):
                 waiter.remaining.add(oid)
                 self._maybe_reconstruct(oid)
+        n_miss = len(waiter.remaining)
+        if n_miss:
+            telemetry.counter_inc(telemetry.M_STORE_MISSES,
+                                  float(n_miss), self._mtags)
+        if len(object_ids) > n_miss:
+            telemetry.counter_inc(telemetry.M_STORE_HITS,
+                                  float(len(object_ids) - n_miss),
+                                  self._mtags)
         if not waiter.remaining:
             self._fire_get(waiter)
             return
@@ -2990,17 +3027,20 @@ class NodeService:
                 self._fire_wait(waiter)
 
     def _fire_get(self, waiter: _Waiter) -> None:
+        metas = [self._lookup_object(oid) for oid in waiter.object_ids]
+        served = sum(m.size for m in metas if m is not None)
+        if served:
+            telemetry.counter_inc(telemetry.M_STORE_GET_BYTES,
+                                  float(served), self._mtags)
         if waiter.fetch:
             # Payload copies + frame pickling for a wire driver can be
             # hundreds of MB; do them off the dispatcher (Connection.send
             # is thread-safe), mirroring why puts live in _DIRECT_OPS.
-            metas = [self._lookup_object(oid) for oid in waiter.object_ids]
             threading.Thread(
                 target=self._fire_get_fetch,
                 args=(waiter, metas), daemon=True,
                 name="rtpu-wire-fetch").start()
             return
-        metas = [self._lookup_object(oid) for oid in waiter.object_ids]
         self._reply(waiter.conn_key, P.GET_REPLY, (waiter.req_id, metas))
 
     def _fire_get_fetch(self, waiter: _Waiter, metas) -> None:
@@ -3317,6 +3357,11 @@ class NodeService:
             return self.gcs.list_cluster_events(limit=10**9)
         if what == "spans":
             return self.gcs.list_spans(limit=10**9)
+        if what == "metrics":
+            # merged cluster-wide telemetry; flush our own shards first
+            # so a scrape right after local activity is never stale
+            telemetry.flush()
+            return self.gcs.metrics_snapshot()
         return None
 
     def _record_event(self, spec: P.TaskSpec, state: str) -> None:

@@ -8,7 +8,28 @@ other objects, and reconstructed in any process of the cluster.
 
 from __future__ import annotations
 
+import threading
+
 from .ids import ObjectID, TaskID
+
+# Per-thread capture of refs pickled into a value. A worker storing a
+# task return activates this around serialization so the node can pin
+# the CONTAINED objects until the return object itself is freed —
+# without it, a ref that only lives inside a not-yet-deserialized
+# return loses its last holder the moment the producer's locals die
+# (reference analogue: borrowed-ref tracking inside returned values,
+# ``reference_count.h``).
+_capture = threading.local()
+
+
+def begin_ref_capture() -> None:
+    _capture.ids = []
+
+
+def end_ref_capture() -> list:
+    ids = getattr(_capture, "ids", None)
+    _capture.ids = None
+    return ids or []
 
 
 class ObjectRef:
@@ -76,6 +97,9 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
+        ids = getattr(_capture, "ids", None)
+        if ids is not None:
+            ids.append(self.id)
         return (ObjectRef, (self.id,))
 
 

@@ -108,6 +108,9 @@ EXECUTE_BATCH = 62      # node -> worker: [EXECUTE_TASK payload, ...]
 # early result is never withheld behind a slow batch successor)
 CANCEL_QUEUED = 64      # node -> worker: task_id queued behind current
 RETURN_LEASED = 65      # worker -> node: [task_id] unstarted leased tasks
+RETURN_REFS = 66        # worker -> node: (return_oid, [contained oids]) —
+                        # refs pickled INSIDE a return; pinned until the
+                        # return object is freed (sent before TASK_DONE)
 
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)

@@ -1,0 +1,560 @@
+"""In-process runtime telemetry core: the record path is a sharded-lock
+dict update — never an RPC.
+
+Equivalent role to the reference's per-node ``MetricsAgent`` →
+Prometheus pipeline (``_private/metrics_agent.py``): every process
+records into process-local shards; a background flusher batch-pushes
+*deltas* to the control plane (direct plane call in node processes, one
+fire-and-forget ``PROFILE_EVENT`` frame in workers/drivers), where they
+merge into the cluster-wide table served by ``export_prometheus()``,
+the dashboard ``/api/metrics`` endpoint and
+``state.api.summarize_metrics()``.
+
+Three layers:
+
+1. record  — ``counter_inc`` / ``gauge_set`` / ``hist_observe``:
+   lock-cheap shard update, histogram stored as cumulative bucket
+   counts + sum/count (bounded memory, unlike raw-observation lists).
+2. flush   — ``flush()`` collects per-shard deltas since the last
+   flush and ships one batch; runs on a timer, after each worker task,
+   and synchronously before an export.
+3. sample  — a per-node sampler thread records host stats (RSS, load,
+   object-store fill) and JAX device stats (``device.memory_stats()``
+   HBM use/limit, jit compile counts), degrading to a no-op on
+   CPU-only JAX.
+
+When tracing is enabled, histogram observations carry the current
+``trace_id`` as an exemplar so slow outliers link back to spans.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import warnings
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .config import CONFIG
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0)
+
+_N_SHARDS = 8
+
+
+class _Hist:
+    __slots__ = ("buckets", "counts", "sum", "count", "exemplar",
+                 "f_counts", "f_sum", "f_count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)      # last slot = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self.exemplar: Optional[dict] = None
+        self.f_counts = [0] * (len(buckets) + 1)    # flushed watermark
+        self.f_sum = 0.0
+        self.f_count = 0
+
+
+class _Shard:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: Dict[tuple, list] = {}       # key -> [live, flushed]
+        self.gauges: Dict[tuple, tuple] = {}        # key -> (value, ts)
+        self.gauges_dirty: set = set()              # keys set since flush
+        self.hists: Dict[tuple, _Hist] = {}
+
+
+_shards = [_Shard() for _ in range(_N_SHARDS)]
+
+# metric metadata, keyed by NAME (Prometheus requires one kind and one
+# bucket layout per name); conflicting re-definitions warn and keep the
+# first definition instead of silently clobbering buckets
+_meta: Dict[str, dict] = {}
+_meta_lock = threading.Lock()
+_conflict_warned: set = set()
+
+# per-process node registry: NodeService instances sampled by the
+# sampler thread and used as the preferred flush transport (direct
+# plane call — no socket hop for node/head processes)
+_nodes: List[Any] = []
+_runtime_lock = threading.Lock()
+_flusher_started = False
+_sampler_started = False
+_last_flush = 0.0
+_jax_listener_installed = False
+
+
+def _shard(key: tuple) -> _Shard:
+    return _shards[hash(key) & (_N_SHARDS - 1)]
+
+
+def define(kind: str, name: str, description: str = "",
+           buckets: Optional[Sequence[float]] = None) -> str:
+    """Register metric metadata once; returns ``name`` so module-level
+    constants read naturally (no side effects beyond the registry —
+    importing an instrumented module must not spawn threads). Kind/
+    bucket conflicts warn and keep the first definition."""
+    b = tuple(buckets) if buckets else (tuple(DEFAULT_BUCKETS)
+                                        if kind == "histogram" else None)
+    with _meta_lock:
+        existing = _meta.get(name)
+        if existing is None:
+            _meta[name] = {"kind": kind, "description": description,
+                           "buckets": b}
+        elif (existing["kind"] != kind
+              or (kind == "histogram" and existing["buckets"] != b)):
+            if name not in _conflict_warned:
+                _conflict_warned.add(name)
+                warnings.warn(
+                    f"metric {name!r} re-defined with conflicting "
+                    f"kind/buckets ({existing['kind']}/"
+                    f"{existing['buckets']} vs {kind}/{b}); keeping the "
+                    "first definition", stacklevel=2)
+        elif description and not existing["description"]:
+            existing["description"] = description
+    return name
+
+
+def enabled() -> bool:
+    return bool(CONFIG.telemetry_enabled)
+
+
+# ------------------------------------------------------------ record path
+
+def counter_inc(name: str, value: float = 1.0, tags: tuple = ()) -> None:
+    if not CONFIG.telemetry_enabled:
+        return
+    if not _flusher_started:
+        _ensure_flusher()
+    key = (name, tags)
+    sh = _shard(key)
+    with sh.lock:
+        ent = sh.counters.get(key)
+        if ent is None:
+            sh.counters[key] = [value, 0.0]
+        else:
+            ent[0] += value
+
+
+def gauge_set(name: str, value: float, tags: tuple = ()) -> None:
+    if not CONFIG.telemetry_enabled:
+        return
+    if not _flusher_started:
+        _ensure_flusher()
+    key = (name, tags)
+    sh = _shard(key)
+    with sh.lock:
+        sh.gauges[key] = (value, time.time())
+        sh.gauges_dirty.add(key)
+
+
+def hist_observe(name: str, value: float, tags: tuple = (),
+                 boundaries: Optional[Tuple[float, ...]] = None) -> None:
+    if not CONFIG.telemetry_enabled:
+        return
+    if not _flusher_started:
+        _ensure_flusher()
+    if boundaries is None:
+        m = _meta.get(name)
+        boundaries = (m["buckets"] if m and m.get("buckets")
+                      else DEFAULT_BUCKETS)
+    key = (name, tags)
+    sh = _shard(key)
+    exemplar = None
+    if CONFIG.tracing_enabled:
+        from ..util import tracing
+        ctx = tracing.get_current_context()
+        if ctx and ctx.get("trace_id"):
+            exemplar = {"trace_id": ctx["trace_id"], "value": value,
+                        "ts": time.time()}
+    idx = bisect_left(boundaries, value)
+    with sh.lock:
+        h = sh.hists.get(key)
+        if h is None:
+            h = sh.hists[key] = _Hist(tuple(boundaries))
+        h.counts[min(idx, len(h.counts) - 1)] += 1
+        h.sum += value
+        h.count += 1
+        if exemplar is not None:
+            h.exemplar = exemplar
+
+
+# --------------------------------------------------------------- flushing
+
+def _collect_deltas() -> Optional[dict]:
+    """Per-shard deltas since the last collect; None when nothing moved.
+    Advances the flushed watermark, so call only with a transport in
+    hand."""
+    counters: Dict[tuple, float] = {}
+    gauges: Dict[tuple, tuple] = {}
+    hists: Dict[tuple, dict] = {}
+    for sh in _shards:
+        with sh.lock:
+            for key, ent in sh.counters.items():
+                d = ent[0] - ent[1]
+                if d:
+                    counters[key] = d
+                    ent[1] = ent[0]
+            for key in sh.gauges_dirty:
+                if key in sh.gauges:
+                    gauges[key] = sh.gauges[key]
+            sh.gauges_dirty.clear()
+            for key, h in sh.hists.items():
+                dc = [a - b for a, b in zip(h.counts, h.f_counts)]
+                if h.count - h.f_count or h.exemplar is not None:
+                    hists[key] = {"buckets": h.buckets, "counts": dc,
+                                  "sum": h.sum - h.f_sum,
+                                  "count": h.count - h.f_count,
+                                  "exemplar": h.exemplar}
+                    h.f_counts = list(h.counts)
+                    h.f_sum = h.sum
+                    h.f_count = h.count
+                    h.exemplar = None
+    if not (counters or gauges or hists):
+        return None
+    with _meta_lock:
+        meta = {name: dict(m) for name, m in _meta.items()}
+    return {"counters": counters, "gauges": gauges, "hists": hists,
+            "meta": meta}
+
+
+def _transport():
+    """Preferred delta sink: a registered node's control plane (direct,
+    no socket), else this process's connected client (one
+    fire-and-forget PROFILE_EVENT frame)."""
+    with _runtime_lock:
+        nodes = list(_nodes)
+    for node in nodes:
+        if not getattr(node, "dead", False):
+            return lambda payload, _g=node.gcs: _g.record_metrics(payload)
+    from . import context as _ctx
+    client = _ctx.current_client
+    if client is not None and not client._closed.is_set():
+        return lambda payload, _c=client: _c.send_profile_event(
+            "metrics", payload)
+    return None
+
+
+def _restore_deltas(payload: dict) -> None:
+    """A send failed after the watermark advanced: roll the watermark
+    back so the deltas ship with the next flush instead of vanishing."""
+    for key, d in payload.get("counters", {}).items():
+        sh = _shard(key)
+        with sh.lock:
+            ent = sh.counters.get(key)
+            if ent is not None:
+                ent[1] -= d
+    for key in payload.get("gauges", {}):
+        sh = _shard(key)
+        with sh.lock:
+            if key in sh.gauges:
+                sh.gauges_dirty.add(key)
+    for key, hd in payload.get("hists", {}).items():
+        sh = _shard(key)
+        with sh.lock:
+            h = sh.hists.get(key)
+            if h is None or h.buckets != tuple(hd["buckets"]):
+                continue
+            h.f_counts = [a - b for a, b in zip(h.f_counts, hd["counts"])]
+            h.f_sum -= hd["sum"]
+            h.f_count -= hd["count"]
+            if h.exemplar is None:
+                h.exemplar = hd.get("exemplar")
+
+
+def flush() -> None:
+    """Ship accumulated deltas to the control plane. Never raises; with
+    no transport available (or a failed send) the deltas keep
+    accumulating locally for the next attempt."""
+    global _last_flush
+    sink = _transport()
+    if sink is None:
+        return
+    payload = _collect_deltas()
+    if payload is None:
+        return
+    _last_flush = time.monotonic()
+    try:
+        sink(payload)
+    except Exception:   # noqa: BLE001 — telemetry must never break work
+        _restore_deltas(payload)
+
+
+def maybe_flush(min_interval_s: float = 0.2) -> None:
+    """Rate-limited flush for per-task-completion call sites: frequent
+    enough for freshness, bounded so a storm of tiny tasks doesn't pay
+    one control-plane frame each."""
+    if time.monotonic() - _last_flush >= min_interval_s:
+        flush()
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    if _flusher_started:
+        return
+    with _runtime_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    t = threading.Thread(target=_flush_loop, daemon=True,
+                         name="rtpu-telemetry-flush")
+    t.start()
+
+
+def _flush_loop() -> None:
+    while True:
+        time.sleep(max(CONFIG.metrics_report_interval_ms, 250) / 1000.0)
+        _install_jax_compile_listener()
+        try:
+            flush()
+        except Exception:   # noqa: BLE001
+            pass
+
+
+# ------------------------------------------------------------- snapshots
+
+def snapshot_local() -> dict:
+    """Merged totals of this process's shards (fallback export surface
+    when no runtime is connected; also what unit tests inspect)."""
+    counters: Dict[tuple, float] = {}
+    gauges: Dict[tuple, tuple] = {}
+    hists: Dict[tuple, dict] = {}
+    for sh in _shards:
+        with sh.lock:
+            for key, ent in sh.counters.items():
+                counters[key] = counters.get(key, 0.0) + ent[0]
+            gauges.update(sh.gauges)
+            for key, h in sh.hists.items():
+                hists[key] = {"buckets": h.buckets,
+                              "counts": list(h.counts),
+                              "sum": h.sum, "count": h.count,
+                              "exemplar": h.exemplar}
+    with _meta_lock:
+        meta = {name: dict(m) for name, m in _meta.items()}
+    return {"counters": counters, "gauges": gauges, "hists": hists,
+            "meta": meta}
+
+
+def reset() -> None:
+    """Drop all local series and node registrations (session teardown:
+    the next init() must not inherit this session's samples)."""
+    for sh in _shards:
+        with sh.lock:
+            sh.counters.clear()
+            sh.gauges.clear()
+            sh.gauges_dirty.clear()
+            sh.hists.clear()
+    with _runtime_lock:
+        _nodes.clear()
+
+
+# ----------------------------------------------------- node runtime hooks
+
+M_TASKS_SUBMITTED = define(
+    "counter", "rtpu_scheduler_tasks_submitted_total",
+    "Tasks submitted to this node's scheduler (incl. actor calls)")
+M_TASKS_DISPATCHED = define(
+    "counter", "rtpu_scheduler_tasks_dispatched_total",
+    "Tasks assigned to a worker by the local dispatcher")
+M_TASKS_FINISHED = define(
+    "counter", "rtpu_scheduler_tasks_finished_total",
+    "Tasks completed on this node, tagged status=ok|error")
+M_QUEUE_WAIT = define(
+    "histogram", "rtpu_scheduler_queue_wait_seconds",
+    "Pending-queue wait between task arrival and worker assignment")
+M_PENDING_TASKS = define(
+    "gauge", "rtpu_scheduler_pending_tasks",
+    "Tasks in the local ready-to-dispatch queue")
+M_STORE_PUTS = define(
+    "counter", "rtpu_object_store_puts_total",
+    "Objects sealed into the local object store")
+M_STORE_PUT_BYTES = define(
+    "counter", "rtpu_object_store_put_bytes_total",
+    "Bytes sealed into the local object store")
+M_STORE_GET_BYTES = define(
+    "counter", "rtpu_object_store_get_bytes_total",
+    "Bytes served to get() callers from this node")
+M_STORE_HITS = define(
+    "counter", "rtpu_object_store_hits_total",
+    "get() lookups resolved immediately from the directory/store")
+M_STORE_MISSES = define(
+    "counter", "rtpu_object_store_misses_total",
+    "get() lookups that had to wait for the object to appear")
+M_STORE_USED = define(
+    "gauge", "rtpu_object_store_used_bytes",
+    "Object store bytes in use (sampled)")
+M_STORE_CAPACITY = define(
+    "gauge", "rtpu_object_store_capacity_bytes",
+    "Object store capacity (sampled)")
+M_STORE_FILL = define(
+    "gauge", "rtpu_object_store_fill_ratio",
+    "used_bytes / capacity_bytes of the local store (sampled)")
+M_STORE_OBJECTS = define(
+    "gauge", "rtpu_object_store_objects",
+    "Live objects in the local store (sampled)")
+M_STORE_SPILLED = define(
+    "gauge", "rtpu_object_store_spilled_objects",
+    "Objects spilled to disk since node start (sampled)")
+M_GCS_RPC_LATENCY = define(
+    "histogram", "rtpu_gcs_rpc_latency_seconds",
+    "Round-trip latency of synchronous control-plane RPCs, tagged by "
+    "method")
+M_GCS_RPC_TOTAL = define(
+    "counter", "rtpu_gcs_rpc_total",
+    "Control-plane RPCs issued, tagged method and kind=call|cast")
+M_NODE_RSS = define(
+    "gauge", "rtpu_node_rss_bytes",
+    "Resident set size of the node service process (sampled)")
+M_NODE_LOAD = define(
+    "gauge", "rtpu_node_cpu_load_1m",
+    "Host 1-minute load average (sampled)")
+M_NODE_WORKERS = define(
+    "gauge", "rtpu_node_workers",
+    "Worker processes attached to this node (sampled)")
+M_HBM_USED = define(
+    "gauge", "rtpu_device_hbm_bytes_in_use",
+    "Accelerator memory in use per JAX device (sampled; absent on "
+    "CPU-only JAX)")
+M_HBM_LIMIT = define(
+    "gauge", "rtpu_device_hbm_bytes_limit",
+    "Accelerator memory limit per JAX device (sampled; absent on "
+    "CPU-only JAX)")
+M_JAX_COMPILES = define(
+    "counter", "rtpu_jax_compiles_total",
+    "JAX compilation events observed in this process")
+M_DROPPED_SERIES = define(
+    "counter", "rtpu_telemetry_dropped_series_total",
+    "Metric series dropped by the control plane (cardinality cap or "
+    "histogram bucket conflicts); synthesized at export from the "
+    "plane's drop counter")
+
+
+def attach_node(node) -> None:
+    """Register a NodeService for host/store sampling and direct-plane
+    flushing; starts the per-process sampler thread on first call."""
+    global _sampler_started
+    with _runtime_lock:
+        if node not in _nodes:
+            _nodes.append(node)
+        start = not _sampler_started
+        _sampler_started = True
+    _ensure_flusher()
+    if start:
+        t = threading.Thread(target=_sample_loop, daemon=True,
+                             name="rtpu-telemetry-sampler")
+        t.start()
+
+
+def detach_node(node) -> None:
+    with _runtime_lock:
+        if node in _nodes:
+            _nodes.remove(node)
+
+
+def _sample_loop() -> None:
+    while True:
+        time.sleep(max(CONFIG.telemetry_sample_interval_ms, 250) / 1000.0)
+        try:
+            sample_once()
+            flush()
+        except Exception:   # noqa: BLE001 — a bad sample is a gap
+            pass
+
+
+def sample_once() -> None:
+    """One host + store + device sampling pass (called by the sampler
+    thread; separately callable for tests)."""
+    with _runtime_lock:
+        nodes = [n for n in _nodes if not getattr(n, "dead", False)]
+    for node in nodes:
+        tags = (("node", node.node_id.hex()[:12]),)
+        try:
+            stats = node.store.stats()
+            used = stats.get("used_bytes", 0)
+            cap = stats.get("capacity_bytes", 0) or 1
+            gauge_set(M_STORE_USED, float(used), tags)
+            gauge_set(M_STORE_CAPACITY, float(cap), tags)
+            gauge_set(M_STORE_FILL, used / cap, tags)
+            gauge_set(M_STORE_OBJECTS, float(stats.get("num_objects", 0)),
+                      tags)
+            gauge_set(M_STORE_SPILLED, float(stats.get("num_spilled", 0)),
+                      tags)
+        except Exception:   # noqa: BLE001
+            pass
+        try:
+            gauge_set(M_PENDING_TASKS, float(len(node._pending)), tags)
+            gauge_set(M_NODE_WORKERS, float(len(node._workers)), tags)
+        except Exception:   # noqa: BLE001
+            pass
+        _sample_host(tags)
+    sample_devices()
+
+
+def _sample_host(tags: tuple) -> None:
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        gauge_set(M_NODE_RSS, float(rss_pages * os.sysconf("SC_PAGE_SIZE")),
+                  tags)
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        gauge_set(M_NODE_LOAD, os.getloadavg()[0], tags)
+    except OSError:
+        pass
+
+
+def sample_devices() -> int:
+    """Record per-device HBM gauges via ``device.memory_stats()``.
+    Returns the number of devices that reported stats; 0 (and records
+    nothing) on CPU-only JAX or when jax was never imported. Never
+    raises."""
+    if "jax" not in sys.modules:
+        return 0
+    _install_jax_compile_listener()
+    reported = 0
+    try:
+        jax = sys.modules["jax"]
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:   # noqa: BLE001 — backend-dependent
+                stats = None
+            if not stats:
+                continue
+            used = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit")
+            tags = (("device", f"{dev.platform}:{dev.id}"),)
+            if used is not None:
+                gauge_set(M_HBM_USED, float(used), tags)
+                reported += 1
+            if limit is not None:
+                gauge_set(M_HBM_LIMIT, float(limit), tags)
+    except Exception:   # noqa: BLE001 — sampling must never raise
+        return reported
+    return reported
+
+
+def _install_jax_compile_listener() -> None:
+    """Count JAX compile events (once per process, only when jax is
+    already imported — telemetry never pulls jax in itself)."""
+    global _jax_listener_installed
+    if _jax_listener_installed or "jax" not in sys.modules:
+        return
+    _jax_listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            if "compile" in event:
+                counter_inc(M_JAX_COMPILES)
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:   # noqa: BLE001 — older/newer jax API drift
+        pass

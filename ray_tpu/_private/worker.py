@@ -361,6 +361,13 @@ class WorkerRuntime:
         # config has tracing off (flush is a no-op on an empty buffer)
         from ..util import tracing
         tracing.flush()
+        # telemetry deltas recorded during the task (collective ops,
+        # serve replicas, data blocks, user metrics) ship at task
+        # boundaries — rate-limited so a storm of tiny recording tasks
+        # pays at most ~5 control-plane frames/s, not one per task; the
+        # background flusher covers the tail
+        from . import telemetry
+        telemetry.maybe_flush()
 
     def _stream_returns(self, spec: P.TaskSpec, kind: str,
                         result: Any) -> None:
@@ -407,9 +414,22 @@ class WorkerRuntime:
                         (spec.task_id, [], err_bytes, kind, produced)))
         from ..util import tracing
         tracing.flush()
+        from . import telemetry
+        telemetry.maybe_flush()
 
     def _store_return(self, oid: ObjectID, value: Any) -> ObjectMeta:
-        smeta, views = ser.serialize(value)
+        from .object_ref import begin_ref_capture, end_ref_capture
+        begin_ref_capture()
+        try:
+            smeta, views = ser.serialize(value)
+        finally:
+            contained = end_ref_capture()
+        if contained:
+            # refs living only inside this return would lose their last
+            # holder when our locals die; the node pins them until the
+            # return object itself is freed. Sent BEFORE this return's
+            # TASK_DONE/GEN_ITEM (same conn => ordered).
+            self.conn.send((P.RETURN_REFS, (oid, contained)))
         total = ser.serialized_size(smeta, views)
         if total <= CONFIG.max_inline_object_bytes:
             out = bytearray(total)

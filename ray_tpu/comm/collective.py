@@ -23,8 +23,28 @@ import numpy as np
 
 from .. import get, get_actor, put
 from ..api import remote
+from .._private import telemetry
 
 _GROUP_ACTOR_PREFIX = "rtpu:collective:"
+
+M_COLL_LATENCY = telemetry.define(
+    "histogram", "rtpu_collective_latency_seconds",
+    "End-to-end latency of one host-level collective call, tagged by "
+    "op and group (the communication axis)")
+M_COLL_BYTES = telemetry.define(
+    "counter", "rtpu_collective_bytes_total",
+    "Payload bytes contributed to collectives by this rank")
+M_COLL_OPS = telemetry.define(
+    "counter", "rtpu_collective_ops_total",
+    "Collective calls completed by this rank")
+
+
+def _observe(op: str, group: str, nbytes: int, t0: float) -> None:
+    tags = (("group", group), ("op", op))
+    telemetry.counter_inc(M_COLL_OPS, 1.0, tags)
+    if nbytes:
+        telemetry.counter_inc(M_COLL_BYTES, float(nbytes), tags)
+    telemetry.hist_observe(M_COLL_LATENCY, time.monotonic() - t0, tags)
 
 # ops
 SUM = "sum"
@@ -251,23 +271,31 @@ def allreduce(tensor, group_name: str = "default", op: str = SUM):
     functional style here, jax arrays are immutable)."""
     state = _state(group_name)
     arr = _to_numpy(tensor)
+    t0 = time.monotonic()
     # Large payloads ride the object store; the coordinator sees refs
     # transparently because args are resolved at task execution.
     result = _rendezvous(state, "allreduce", put(arr), op)
+    _observe("allreduce", group_name, arr.nbytes, t0)
     return result
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     state = _state(group_name)
-    parts = _rendezvous(state, "allgather", put(_to_numpy(tensor)), None)
+    arr = _to_numpy(tensor)
+    t0 = time.monotonic()
+    parts = _rendezvous(state, "allgather", put(arr), None)
+    _observe("allgather", group_name, arr.nbytes, t0)
     return [np.asarray(p) for p in parts]
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = SUM):
     """Reduce then return this rank's 1/world_size slice along axis 0."""
     state = _state(group_name)
+    arr = _to_numpy(tensor)
+    t0 = time.monotonic()
     reduced = np.asarray(_rendezvous(state, "reducescatter",
-                                     put(_to_numpy(tensor)), op))
+                                     put(arr), op))
+    _observe("reducescatter", group_name, arr.nbytes, t0)
     if reduced.shape[0] % state.world_size:
         raise ValueError(
             f"reducescatter: leading dim {reduced.shape[0]} not divisible "
@@ -278,14 +306,20 @@ def reducescatter(tensor, group_name: str = "default", op: str = SUM):
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     state = _state(group_name)
-    payload = put(_to_numpy(tensor)) if state.rank == src_rank else None
+    arr = _to_numpy(tensor)
+    t0 = time.monotonic()
+    payload = put(arr) if state.rank == src_rank else None
     parts = _rendezvous(state, "broadcast", payload, None)
+    _observe("broadcast", group_name,
+             arr.nbytes if state.rank == src_rank else 0, t0)
     return np.asarray(parts[src_rank])
 
 
 def barrier(group_name: str = "default") -> None:
     state = _state(group_name)
+    t0 = time.monotonic()
     _rendezvous(state, "barrier", None, None)
+    _observe("barrier", group_name, 0, t0)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default",
@@ -293,8 +327,11 @@ def send(tensor, dst_rank: int, group_name: str = "default",
     state = _state(group_name)
     seq = state.send_seq.get((dst_rank, tag), 0)
     state.send_seq[(dst_rank, tag)] = seq + 1
+    arr = _to_numpy(tensor)
+    t0 = time.monotonic()
     get(state.coordinator.post.remote(
-        dst_rank, (state.rank, tag, seq), put(_to_numpy(tensor))))
+        dst_rank, (state.rank, tag, seq), put(arr)))
+    _observe("send", group_name, arr.nbytes, t0)
 
 
 def recv(src_rank: int, group_name: str = "default", tag: int = 0,
@@ -302,13 +339,16 @@ def recv(src_rank: int, group_name: str = "default", tag: int = 0,
     state = _state(group_name)
     seq = state.recv_seq.get((src_rank, tag), 0)
     state.recv_seq[(src_rank, tag)] = seq + 1
+    t0 = time.monotonic()
     deadline = time.monotonic() + timeout
     delay = 0.001
     while True:
         ok, value = get(state.coordinator.take.remote(
             state.rank, (src_rank, tag, seq)))
         if ok:
-            return np.asarray(value)
+            arr = np.asarray(value)
+            _observe("recv", group_name, arr.nbytes, t0)
+            return arr
         if time.monotonic() > deadline:
             raise TimeoutError(f"recv from rank {src_rank} timed out")
         time.sleep(delay)

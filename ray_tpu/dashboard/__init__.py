@@ -97,6 +97,11 @@ _HTML = """<!doctype html>
 <input id="tid" placeholder="task id (hex or prefix)" size="36">
 <button onclick="drill()">show timeline</button>
 <table id="taskevents"></table>
+<h2>Runtime metrics</h2>
+<div style="font-size:.8rem">merged telemetry table
+ (<a href="api/metrics">JSON</a> &middot;
+  <a href="metrics">Prometheus</a>)</div>
+<table id="metrics"></table>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Tasks (latest state)</h2><table id="tasks"></table>
@@ -180,8 +185,24 @@ async function refreshHist() {
     drawHistory(h.samples || []);
   } catch (e) {}
 }
+async function refreshMetrics() {
+  try {
+    const m = await (await fetch("api/metrics")).json();
+    const rows = (m.metrics || []).map(r => ({
+      name: r.name, kind: r.kind,
+      tags: Object.entries(r.tags || {}).map(
+        ([k, v]) => `${k}=${v}`).join(","),
+      value: r.kind === "histogram"
+        ? `count=${r.count} mean=${r.count
+            ? (r.sum / r.count).toFixed(4) : "-"}`
+        : r.value,
+    }));
+    fill("metrics", rows, ["name", "kind", "tags", "value"]);
+  } catch (e) {}
+}
 refresh(); setInterval(refresh, 2000);
 refreshHist(); setInterval(refreshHist, 4000);
+refreshMetrics(); setInterval(refreshMetrics, 4000);
 </script></body></html>
 """
 
@@ -238,6 +259,25 @@ class _Handler(JsonHandler):
                 hist = getattr(self, "history", None)
                 return self._json(200, {
                     "samples": list(hist.samples) if hist else []})
+            if path == "/api/metrics":
+                snap = node._state_query("metrics", None) or {}
+                return self._json(200, {
+                    "metrics": state_api.shape_metrics(snap),
+                    "dropped_series": snap.get("dropped_series", 0)})
+            if path == "/metrics":
+                # Prometheus scrape surface on the dashboard port (same
+                # merged table the JSON endpoint serves)
+                from ..util.metrics import format_prometheus
+                body = format_prometheus(
+                    node._state_query("metrics", None) or {},
+                    include_exemplars=False).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
             if path.startswith("/api/task/"):
                 # drill-down: every recorded state transition of one
                 # task (id or unique hex prefix), time-ordered

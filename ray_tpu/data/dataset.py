@@ -29,12 +29,35 @@ from typing import (Any, Callable, Dict, Iterator, List, Optional,
 import numpy as np
 
 from .. import get, put, wait
+from .._private import telemetry
 from ..api import remote
 from . import block as B
 
 Block = B.Block
 
 _DEFAULT_WINDOW = 8
+
+M_DATA_BLOCKS = telemetry.define(
+    "counter", "rtpu_data_blocks_total",
+    "Blocks produced by data-plane operators, tagged by op")
+M_DATA_ROWS = telemetry.define(
+    "counter", "rtpu_data_block_rows_total",
+    "Rows in blocks produced by data-plane operators")
+M_DATA_BYTES = telemetry.define(
+    "counter", "rtpu_data_block_bytes_total",
+    "Bytes (numeric columns) in blocks produced by data-plane operators")
+
+
+def _record_block(blk: Block, op: str) -> Block:
+    tags = (("op", op),)
+    telemetry.counter_inc(M_DATA_BLOCKS, 1.0, tags)
+    telemetry.counter_inc(M_DATA_ROWS, float(B.block_num_rows(blk)), tags)
+    nbytes = sum(v.nbytes for v in blk.values()
+                 if getattr(v, "dtype", None) is not None
+                 and v.dtype != object)
+    if nbytes:
+        telemetry.counter_inc(M_DATA_BYTES, float(nbytes), tags)
+    return blk
 
 
 # A stage is ("map_batches"|"map"|"filter"|"flat_map", fn, kwargs)
@@ -87,7 +110,7 @@ def _run_block_task(source_fn: Optional[Callable], source_block,
                     stages: List[Stage]) -> Block:
     blk = source_fn() if source_fn is not None else source_block
     blk = B.normalize_block(blk)
-    return _apply_stages(blk, stages)
+    return _record_block(_apply_stages(blk, stages), "map_task")
 
 
 @remote
@@ -108,7 +131,7 @@ def _run_gen_source(source_fn: Callable):
     Reference analogue: a read task streaming its output blocks through
     ObjectRefGenerator."""
     for blk in source_fn():
-        yield B.normalize_block(blk)
+        yield _record_block(B.normalize_block(blk), "gen_source")
 
 
 @remote
@@ -121,7 +144,9 @@ class _UDFActor:
         self.stage_kw = stage_kw
 
     def call_block(self, blk: Block) -> Block:
-        return _apply_stages(blk, [(self.kind, self.fn, self.stage_kw)])
+        return _record_block(
+            _apply_stages(blk, [(self.kind, self.fn, self.stage_kw)]),
+            "actor_pool")
 
 
 @remote

@@ -1,0 +1,105 @@
+"""Metric-registry lint: every runtime metric the code defines must be
+a valid Prometheus name AND documented in README.md's Observability
+registry — new instrumentation can't ship undocumented.
+
+Wired in as a tier-1 test (``tests/test_metric_lint.py``); also runnable
+standalone: ``python -m ray_tpu.scripts.check_metrics``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+# Prometheus metric-name grammar (https://prometheus.io/docs/concepts/
+# data_model/) narrowed to this repo's convention: rtpu_ prefix,
+# lower-snake-case. `_bucket`/`_sum`/`_count`/`_total` suffixes are part
+# of the name as defined.
+_NAME_RE = re.compile(r"^rtpu_[a-z][a-z0-9_]*$")
+_README_NAME_RE = re.compile(r"`(rtpu_[A-Za-z0-9_:]+)`")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def collect_defined_metrics(pkg_dir: str) -> Dict[str, str]:
+    """All metric names registered via ``telemetry.define(kind, name,
+    ...)`` anywhere under the package, mapped to the defining file."""
+    out: Dict[str, str] = {}
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.attr if isinstance(fn, ast.Attribute)
+                        else fn.id if isinstance(fn, ast.Name) else None)
+                if name != "define" or len(node.args) < 2:
+                    continue
+                arg = node.args[1]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("rtpu_")):
+                    out[arg.value] = os.path.relpath(path, pkg_dir)
+    return out
+
+
+def readme_metric_names(readme_path: str) -> Set[str]:
+    try:
+        with open(readme_path) as f:
+            return set(_README_NAME_RE.findall(f.read()))
+    except OSError:
+        return set()
+
+
+def check(repo_root: str = None) -> List[str]:
+    """Returns a list of problems (empty = clean)."""
+    root = repo_root or _repo_root()
+    defined = collect_defined_metrics(os.path.join(root, "ray_tpu"))
+    documented = readme_metric_names(os.path.join(root, "README.md"))
+    problems: List[str] = []
+    if not defined:
+        problems.append("no telemetry.define() metric names found under "
+                        "ray_tpu/ — the scanner is broken")
+    for name, where in sorted(defined.items()):
+        if not _NAME_RE.match(name):
+            problems.append(
+                f"{name} ({where}): violates the Prometheus naming "
+                "grammar / rtpu_ lower-snake-case convention")
+        if name not in documented:
+            problems.append(
+                f"{name} ({where}): not documented in the README.md "
+                "Observability metric registry")
+    for name in sorted(documented - set(defined)):
+        problems.append(
+            f"{name}: listed in the README registry but no "
+            "telemetry.define() in ray_tpu/ registers it")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"metric-lint: {p}", file=sys.stderr)
+    if problems:
+        print(f"metric-lint: {len(problems)} problem(s)", file=sys.stderr)
+        return 1
+    print("metric-lint: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

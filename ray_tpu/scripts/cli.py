@@ -121,6 +121,17 @@ def cmd_summary(client, args) -> None:
     print(json.dumps(summary, indent=2, default=str))
 
 
+def cmd_metrics(client, args) -> None:
+    """Cluster-wide runtime metrics: Prometheus text (default) or the
+    per-metric summary rollup."""
+    if args.format == "summary":
+        from ..state import summarize_metrics
+        print(json.dumps(summarize_metrics(), indent=2, default=str))
+    else:
+        from ..util.metrics import export_prometheus
+        print(export_prometheus(), end="")
+
+
 def cmd_memory(client, args) -> None:
     stats = client.cluster_info("store_stats") or {}
     for k, v in sorted(stats.items()):
@@ -253,6 +264,10 @@ def main(argv=None) -> None:
                         default="table")
     p_sum = sub.add_parser("summary")
     p_sum.add_argument("what", choices=("tasks", "actors"))
+    p_met = sub.add_parser("metrics",
+                           help="runtime metrics (Prometheus or summary)")
+    p_met.add_argument("--format", choices=("prom", "summary"),
+                       default="prom")
     sub.add_parser("memory")
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("-o", "--output")
@@ -319,8 +334,8 @@ def main(argv=None) -> None:
     client = _connect(session)
     try:
         {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
-         "memory": cmd_memory, "timeline": cmd_timeline}[args.command](
-             client, args)
+         "memory": cmd_memory, "timeline": cmd_timeline,
+         "metrics": cmd_metrics}[args.command](client, args)
     finally:
         try:
             client.close()

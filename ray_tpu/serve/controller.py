@@ -89,7 +89,7 @@ class ServeController:
             opts.setdefault("max_concurrency", rec["max_concurrency"])
         while have < want:
             replica = rep.Replica.options(**opts).remote(
-                cls_blob, args, kwargs)
+                cls_blob, args, kwargs, name)
             with self._lock:
                 rec["replicas"].append(replica)
             have += 1

@@ -7,14 +7,27 @@ handle_request → user callable, queue metrics for autoscaling).
 from __future__ import annotations
 
 import threading
+import time
 
+from .._private import telemetry
 from ..api import remote
+
+M_SERVE_LATENCY = telemetry.define(
+    "histogram", "rtpu_serve_request_latency_seconds",
+    "Replica-side request handling latency, tagged by deployment")
+M_SERVE_REQUESTS = telemetry.define(
+    "counter", "rtpu_serve_requests_total",
+    "Requests handled by serve replicas, tagged deployment and "
+    "status=ok|error")
+M_SERVE_QUEUE_DEPTH = telemetry.define(
+    "gauge", "rtpu_serve_replica_queue_depth",
+    "Requests executing + queued on this replica (autoscaling signal)")
 
 
 @remote(max_concurrency=8)
 class Replica:
     def __init__(self, cls_blob: bytes, init_args: tuple,
-                 init_kwargs: dict):
+                 init_kwargs: dict, deployment_name: str = ""):
         from .._private import serialization as ser
         target = ser.loads_function(cls_blob)
         if isinstance(target, type):
@@ -23,17 +36,53 @@ class Replica:
             self._instance = target          # plain function deployment
         self._depth = 0
         self._depth_lock = threading.Lock()
+        self._mtags = (("deployment", deployment_name or "default"),)
 
-    def handle_request(self, *args, **kwargs):
+    def _enter(self) -> None:
         with self._depth_lock:
             self._depth += 1
+            depth = self._depth
+        telemetry.gauge_set(M_SERVE_QUEUE_DEPTH, float(depth), self._mtags)
+
+    def _exit(self, t0: float, ok: bool) -> None:
+        with self._depth_lock:
+            self._depth -= 1
+            depth = self._depth
+        telemetry.gauge_set(M_SERVE_QUEUE_DEPTH, float(depth), self._mtags)
+        telemetry.hist_observe(M_SERVE_LATENCY, time.monotonic() - t0,
+                               self._mtags)
+        telemetry.counter_inc(
+            M_SERVE_REQUESTS, 1.0,
+            self._mtags + (("status", "ok" if ok else "error"),))
+
+    def handle_request(self, *args, **kwargs):
+        import inspect
+        self._enter()
+        t0 = time.monotonic()
         try:
             if not callable(self._instance):
                 raise TypeError("deployment target is not callable")
-            return self._instance(*args, **kwargs)
+            result = self._instance(*args, **kwargs)
+        except BaseException:
+            self._exit(t0, ok=False)
+            raise
+        if inspect.isgenerator(result):
+            # streaming: the request is live until the stream drains —
+            # record latency/status (and release the queue-depth slot)
+            # at exhaustion, not at generator creation
+            return self._track_stream(result, t0)
+        self._exit(t0, ok=True)
+        return result
+
+    def _track_stream(self, gen, t0: float):
+        ok = True
+        try:
+            yield from gen
+        except BaseException:
+            ok = False
+            raise
         finally:
-            with self._depth_lock:
-                self._depth -= 1
+            self._exit(t0, ok)
 
     def handle_request_mux(self, model_id: str, *args, **kwargs):
         """handle_request with the request's multiplexed model id bound
@@ -67,13 +116,16 @@ class Replica:
         return out
 
     def call_method(self, method_name: str, *args, **kwargs):
-        with self._depth_lock:
-            self._depth += 1
+        self._enter()
+        t0 = time.monotonic()
+        ok = True
         try:
             return getattr(self._instance, method_name)(*args, **kwargs)
+        except BaseException:
+            ok = False
+            raise
         finally:
-            with self._depth_lock:
-                self._depth -= 1
+            self._exit(t0, ok)
 
     def queue_depth(self) -> int:
         # executing + queued requests on this replica (approximation of

@@ -9,12 +9,14 @@ records straight from the control plane.
 from .api import (  # noqa: F401
     list_actors,
     list_jobs,
+    list_metrics,
     list_nodes,
     list_objects,
     list_placement_groups,
     list_tasks,
     list_workers,
     summarize_actors,
+    summarize_metrics,
     summarize_tasks,
     timeline,
 )

@@ -80,6 +80,42 @@ def shape_nodes(recs: List[dict]) -> List[dict]:
     return [{**rec, "node_id": _hex(rec["node_id"])} for rec in recs or []]
 
 
+def shape_metrics(snap: Optional[dict]) -> List[dict]:
+    """Flatten a telemetry snapshot (tuple-keyed tables) into JSON-able
+    series rows, shared by the dashboard ``/api/metrics`` endpoint and
+    ``summarize_metrics``."""
+    snap = snap or {}
+    meta = snap.get("meta") or {}
+    rows: List[dict] = []
+
+    def base(name: str, tags: tuple) -> dict:
+        m = meta.get(name) or {}
+        return {"name": name, "kind": m.get("kind"),
+                "description": m.get("description") or "",
+                "tags": dict(tags)}
+
+    for (name, tags), value in (snap.get("counters") or {}).items():
+        rows.append({**base(name, tags), "kind": "counter",
+                     "value": value})
+    for (name, tags), (value, ts) in (snap.get("gauges") or {}).items():
+        rows.append({**base(name, tags), "kind": "gauge", "value": value,
+                     "timestamp": ts})
+    for (name, tags), h in (snap.get("hists") or {}).items():
+        buckets = list(h.get("buckets") or ())
+        counts = list(h.get("counts") or ())
+        cumulative, cum = [], 0
+        for i, b in enumerate(buckets):
+            cum += counts[i] if i < len(counts) else 0
+            cumulative.append([b, cum])
+        rows.append({**base(name, tags), "kind": "histogram",
+                     "buckets": cumulative,
+                     "sum": h.get("sum", 0.0),
+                     "count": h.get("count", 0),
+                     "exemplar": h.get("exemplar")})
+    rows.sort(key=lambda r: (r["name"], sorted(r["tags"].items())))
+    return rows
+
+
 def list_tasks(filters: Optional[dict] = None,
                limit: int = 1000) -> List[dict]:
     """Task state transitions (latest state per task)."""
@@ -136,6 +172,43 @@ def summarize_actor_rows(rows: List[dict]) -> Dict[str, Any]:
         by_class[r["class_name"]][r["state"]] += 1
     return {"total": len(rows), "by_state": dict(by_state),
             "by_class": {k: dict(v) for k, v in by_class.items()}}
+
+
+def list_metrics(filters: Optional[dict] = None,
+                 limit: int = 10000) -> List[dict]:
+    """Cluster-wide runtime + user metric series (merged telemetry
+    table on the control plane)."""
+    rows = shape_metrics(_query("metrics"))
+    if filters:
+        name = filters.get("name")
+        if name is not None:
+            rows = [r for r in rows if r["name"] == name]
+        rows = [r for r in rows
+                if all(str(r["tags"].get(k)) == str(v)
+                       for k, v in filters.items() if k != "name")]
+    return rows[:limit]
+
+
+def summarize_metrics() -> Dict[str, Any]:
+    """Per-metric rollup: series count plus a kind-appropriate total
+    (counter sum, latest gauge values, histogram count/mean) — the
+    ``ray summary``-style view of the telemetry table."""
+    out: Dict[str, Any] = {}
+    for row in shape_metrics(_query("metrics")):
+        ent = out.setdefault(row["name"], {
+            "kind": row["kind"], "description": row["description"],
+            "series": 0})
+        ent["series"] += 1
+        if row["kind"] == "counter":
+            ent["total"] = ent.get("total", 0.0) + row["value"]
+        elif row["kind"] == "gauge":
+            ent["last"] = row["value"]
+        else:
+            ent["count"] = ent.get("count", 0) + row["count"]
+            ent["sum"] = ent.get("sum", 0.0) + row["sum"]
+            if ent["count"]:
+                ent["mean"] = ent["sum"] / ent["count"]
+    return out
 
 
 def summarize_tasks() -> Dict[str, Any]:

@@ -2,94 +2,25 @@
 
 Reference: ``python/ray/util/metrics.py`` (same three types, tag
 support) + the per-node ``MetricsAgent`` → Prometheus pipeline
-(``_private/metrics_agent.py:416``). Here every process records locally
-and pushes to a named aggregator actor (fire-and-forget); export is
-Prometheus text format via ``export_prometheus()`` or an HTTP endpoint
-(``start_metrics_http``).
+(``_private/metrics_agent.py:416``). The transport underneath is
+``_private/telemetry.py``: every record call is a process-local
+sharded-dict update (no RPC on the sample path); a background flusher
+batch-pushes deltas to the control plane, where runtime and user
+metrics merge into one cluster-wide table. Export is Prometheus text
+format via ``export_prometheus()`` / ``start_metrics_http()``, the
+dashboard's ``/api/metrics`` JSON endpoint, and
+``state.api.summarize_metrics()``.
 """
 
 from __future__ import annotations
 
 import threading
-import time
-from collections import defaultdict
-from typing import Dict, List, Optional, Sequence, Tuple
+from bisect import bisect_left
+from typing import Dict, List, Optional, Sequence
 
-from ..api import remote
+from .._private import telemetry
 
-_AGGREGATOR_NAME = "rtpu:metrics_aggregator"
-_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
-                    5.0, 10.0)
-
-
-@remote(num_cpus=0, max_concurrency=8)
-class _Aggregator:
-    def __init__(self):
-        self._counters: Dict[tuple, float] = defaultdict(float)
-        self._gauges: Dict[tuple, float] = {}
-        self._hists: Dict[tuple, List[float]] = defaultdict(list)
-        self._meta: Dict[str, dict] = {}
-
-    def record(self, kind: str, name: str, description: str,
-               tags: tuple, value: float, buckets=None) -> None:
-        key = (name, tags)
-        self._meta[name] = {"kind": kind, "description": description,
-                            "buckets": buckets}
-        if kind == "counter":
-            self._counters[key] += value
-        elif kind == "gauge":
-            self._gauges[key] = value
-        else:
-            self._hists[key].append(value)
-
-    def snapshot(self) -> dict:
-        return {
-            "counters": dict(self._counters),
-            "gauges": dict(self._gauges),
-            "histograms": {k: list(v) for k, v in self._hists.items()},
-            "meta": dict(self._meta),
-        }
-
-
-_agg_cache = None          # (client, actor) — invalidated on re-init
-_agg_lock = threading.Lock()
-
-
-def _get_aggregator(create: bool = True):
-    """Named-actor rendezvous. Creation can race across workers — the
-    loser's creation fails (duplicate name), so confirm with a real call
-    and fall back to lookup."""
-    global _agg_cache
-    from .. import get, get_actor
-    from .._private import context as _ctx
-    client = _ctx.require_client()
-    with _agg_lock:
-        if _agg_cache is not None and _agg_cache[0] is client:
-            return _agg_cache[1]
-        _agg_cache = None
-        try:
-            actor = get_actor(_AGGREGATOR_NAME)
-            _agg_cache = (client, actor)
-            return actor
-        except ValueError:
-            if not create:
-                return None
-        try:
-            actor = _Aggregator.options(name=_AGGREGATOR_NAME,
-                                        lifetime="detached").remote()
-            get(actor.snapshot.remote())    # forces creation to resolve
-            _agg_cache = (client, actor)
-            return actor
-        except Exception:
-            deadline = time.monotonic() + 5.0
-            while time.monotonic() < deadline:
-                try:
-                    actor = get_actor(_AGGREGATOR_NAME)
-                    _agg_cache = (client, actor)
-                    return actor
-                except ValueError:
-                    time.sleep(0.05)
-            raise
+_DEFAULT_BUCKETS = telemetry.DEFAULT_BUCKETS
 
 
 class _Metric:
@@ -101,7 +32,10 @@ class _Metric:
         self._description = description
         self._tag_keys = tuple(tag_keys)
         self._default_tags: Dict[str, str] = {}
-        self._buckets = None
+        # Histogram sets its buckets before delegating here; one define
+        # covers all kinds so bucket/kind conflicts are caught centrally
+        self._buckets = getattr(self, "_buckets", None)
+        telemetry.define(self.KIND, name, description, self._buckets)
 
     def set_default_tags(self, tags: Dict[str, str]) -> "_Metric":
         self._default_tags = dict(tags)
@@ -111,12 +45,6 @@ class _Metric:
         merged = {**self._default_tags, **(tags or {})}
         return tuple(sorted(merged.items()))
 
-    def _record(self, value: float, tags: Optional[Dict[str, str]]):
-        agg = _get_aggregator()
-        agg.record.remote(self.KIND, self._name, self._description,
-                          self._tags_tuple(tags), float(value),
-                          self._buckets)
-
 
 class Counter(_Metric):
     KIND = "counter"
@@ -125,7 +53,8 @@ class Counter(_Metric):
             tags: Optional[Dict[str, str]] = None) -> None:
         if value < 0:
             raise ValueError("counters only increase")
-        self._record(value, tags)
+        telemetry.counter_inc(self._name, float(value),
+                              self._tags_tuple(tags))
 
 
 class Gauge(_Metric):
@@ -133,7 +62,8 @@ class Gauge(_Metric):
 
     def set(self, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
-        self._record(value, tags)
+        telemetry.gauge_set(self._name, float(value),
+                            self._tags_tuple(tags))
 
 
 class Histogram(_Metric):
@@ -142,50 +72,124 @@ class Histogram(_Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: Sequence[float] = _DEFAULT_BUCKETS,
                  tag_keys: Sequence[str] = ()):
-        super().__init__(name, description, tag_keys)
         self._buckets = tuple(boundaries)
+        super().__init__(name, description, tag_keys)
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
-        self._record(value, tags)
+        telemetry.hist_observe(self._name, float(value),
+                               self._tags_tuple(tags), self._buckets)
+
+
+# ------------------------------------------------------------- exposition
+
+def _fmt_tags(tags: tuple) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in tags)
+    return "{" + inner + "}"
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_prometheus(snap: dict, include_exemplars: bool = True) -> str:
+    """Prometheus text exposition of one metrics snapshot (the merged
+    control-plane table or a process-local one). ``# HELP``/``# TYPE``
+    are emitted once per metric NAME (exposition-format requirement),
+    with every tagged series grouped under its header. Histogram
+    exemplars (trace ids captured while tracing was enabled) ride the
+    matching bucket line in OpenMetrics syntax — pass
+    ``include_exemplars=False`` for surfaces that advertise the classic
+    ``text/plain; version=0.0.4`` content type, whose parsers reject
+    the exemplar token (the HTTP scrape endpoints do)."""
+    meta = snap.get("meta") or {}
+    by_name: Dict[str, dict] = {}
+
+    def series_of(name: str) -> dict:
+        ent = by_name.get(name)
+        if ent is None:
+            ent = by_name[name] = {"counters": [], "gauges": [],
+                                   "hists": []}
+        return ent
+
+    for (name, tags), value in (snap.get("counters") or {}).items():
+        series_of(name)["counters"].append((tags, value))
+    for (name, tags), (value, _ts) in (snap.get("gauges") or {}).items():
+        series_of(name)["gauges"].append((tags, value))
+    for (name, tags), h in (snap.get("hists") or {}).items():
+        series_of(name)["hists"].append((tags, h))
+    if snap.get("dropped_series"):
+        series_of("rtpu_telemetry_dropped_series_total")["counters"].append(
+            ((), float(snap["dropped_series"])))
+        meta = {**meta, "rtpu_telemetry_dropped_series_total": {
+            "kind": "counter",
+            "description": "Metric series dropped by the control plane "
+                           "(cardinality cap or bucket conflicts)"}}
+
+    lines: List[str] = []
+    for name in sorted(by_name):
+        ent = by_name[name]
+        m = meta.get(name) or {}
+        kind = m.get("kind") or ("histogram" if ent["hists"] else
+                                 "gauge" if ent["gauges"] else "counter")
+        desc = m.get("description") or ""
+        if desc:
+            lines.append(f"# HELP {name} {_escape_help(desc)}")
+        lines.append(f"# TYPE {name} {kind}")
+        for tags, value in sorted(ent["counters"]) + sorted(ent["gauges"]):
+            lines.append(f"{name}{_fmt_tags(tags)} {float(value)}")
+        for tags, h in sorted(ent["hists"], key=lambda kv: kv[0]):
+            buckets = tuple(h.get("buckets") or _DEFAULT_BUCKETS)
+            counts = list(h.get("counts") or [0] * (len(buckets) + 1))
+            ex = h.get("exemplar") if include_exemplars else None
+            ex_idx = (min(bisect_left(buckets, ex["value"]), len(buckets))
+                      if ex else -1)
+            cumulative = 0
+            for i, b in enumerate(buckets):
+                cumulative += counts[i] if i < len(counts) else 0
+                line = (f"{name}_bucket"
+                        f"{_fmt_tags(tags + (('le', str(b)),))} "
+                        f"{cumulative}")
+                if i == ex_idx:
+                    line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                             f'{ex["value"]} {ex["ts"]}')
+                lines.append(line)
+            total = int(h.get("count", sum(counts)))
+            inf_line = (f"{name}_bucket"
+                        f"{_fmt_tags(tags + (('le', '+Inf'),))} {total}")
+            if ex_idx == len(buckets):
+                inf_line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                             f'{ex["value"]} {ex["ts"]}')
+            lines.append(inf_line)
+            lines.append(f"{name}_sum{_fmt_tags(tags)} "
+                         f"{float(h.get('sum', 0.0))}")
+            lines.append(f"{name}_count{_fmt_tags(tags)} {total}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def metrics_snapshot() -> dict:
+    """The merged cluster-wide metrics table (this process's shards are
+    flushed first). Falls back to the process-local view when no
+    runtime is connected."""
+    telemetry.flush()
+    from .._private import context as _ctx
+    client = _ctx.current_client
+    if client is not None:
+        try:
+            snap = client.state_query("metrics", None)
+            if snap is not None:
+                return snap
+        except Exception:   # noqa: BLE001 — export must not raise
+            pass
+    return telemetry.snapshot_local()
 
 
 def export_prometheus() -> str:
-    """Prometheus text exposition of all recorded metrics."""
-    from .. import get
-    agg = _get_aggregator(create=False)
-    if agg is None:
-        return ""
-    snap = get(agg.snapshot.remote())
-    lines: List[str] = []
-
-    def fmt_tags(tags: tuple) -> str:
-        if not tags:
-            return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in tags)
-        return "{" + inner + "}"
-
-    meta = snap["meta"]
-    for (name, tags), value in sorted(snap["counters"].items()):
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name}{fmt_tags(tags)} {value}")
-    for (name, tags), value in sorted(snap["gauges"].items()):
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name}{fmt_tags(tags)} {value}")
-    for (name, tags), values in sorted(snap["histograms"].items()):
-        buckets = (meta.get(name, {}).get("buckets")
-                   or _DEFAULT_BUCKETS)
-        lines.append(f"# TYPE {name} histogram")
-        cumulative = 0
-        for b in buckets:
-            cumulative = sum(1 for v in values if v <= b)
-            tag_str = fmt_tags(tags + (("le", str(b)),))
-            lines.append(f"{name}_bucket{tag_str} {cumulative}")
-        inf_tags = fmt_tags(tags + (("le", "+Inf"),))
-        lines.append(f"{name}_bucket{inf_tags} {len(values)}")
-        lines.append(f"{name}_sum{fmt_tags(tags)} {sum(values)}")
-        lines.append(f"{name}_count{fmt_tags(tags)} {len(values)}")
-    return "\n".join(lines) + ("\n" if lines else "")
+    """Prometheus text exposition of all recorded metrics (head scrape
+    surface; reference: the per-node agent's scrape endpoint)."""
+    return format_prometheus(metrics_snapshot())
 
 
 _http_server = None
@@ -199,7 +203,9 @@ def start_metrics_http(host: str = "127.0.0.1", port: int = 0) -> str:
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            body = export_prometheus().encode()
+            # classic 0.0.4 content type: no exemplar tokens
+            body = format_prometheus(metrics_snapshot(),
+                                     include_exemplars=False).encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")

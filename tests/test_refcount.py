@@ -45,6 +45,52 @@ def test_object_freed_when_last_ref_dies(rtpu_init):
                 msg="directory entry dropped after free")
 
 
+def test_refs_nested_in_returns_survive_producer_drop(rtpu_init):
+    """A ref that lives only INSIDE a not-yet-deserialized return must
+    keep its object alive past the producer worker's local drops + the
+    zero-grace window (regression: push-based shuffle chunk refs were
+    freed before the driver ever unpickled the map results, deadlocking
+    random_shuffle)."""
+    @ray_tpu.remote
+    def make():
+        return [ray_tpu.put(np.arange(10))]
+
+    result_ref = make.remote()
+    # let the producer finish, drop its locals, and the grace expire
+    # long before the driver looks at the result
+    time.sleep(1.0)
+    inner = ray_tpu.get(result_ref)[0]
+    val = ray_tpu.get(inner, timeout=10)
+    assert list(val) == list(range(10))
+    # once BOTH the return and the inner ref die, the nested object is
+    # garbage and must actually be freed (pins released)
+    oid = inner.id
+    node = ray_tpu._global_node
+    del inner, val, result_ref
+    gc.collect()
+    _wait_until(lambda: not _store_has(node, oid),
+                msg="nested object freed after pins release")
+
+
+def test_refs_nested_in_put_survive_local_drop(rtpu_init):
+    """Same class of bug via put(): a ref stored INSIDE a put object
+    must outlive the caller's own Python ref to it."""
+    inner = ray_tpu.put(np.arange(6))
+    outer = ray_tpu.put([inner])
+    inner_oid = inner.id
+    del inner
+    gc.collect()
+    time.sleep(1.0)     # local drop + grace expire with only the
+    #                     containment edge keeping the object alive
+    fetched = ray_tpu.get(outer)[0]
+    assert list(ray_tpu.get(fetched, timeout=10)) == list(range(6))
+    node = ray_tpu._global_node
+    del fetched, outer
+    gc.collect()
+    _wait_until(lambda: not _store_has(node, inner_oid),
+                msg="nested put object freed after container dies")
+
+
 def test_task_args_pin_object(rtpu_init):
     """Dropping the last Python ref right after submission must not free
     the object out from under the in-flight task."""
