@@ -229,6 +229,31 @@ class CoreClient:
                     ev[0] = consumed
                 if ev is not None:
                     ev[1].set()
+        elif op == P.STACK_DUMP:
+            # answered from THIS (reader) thread on purpose: it is never
+            # the one blocked in user code, so a process wedged in get()
+            # still reports every thread's stack (reference: `ray stack`)
+            from . import debugging
+            try:
+                dump = debugging.collect_stack_dump(
+                    kind=("worker" if self.kind == P.KIND_WORKER
+                          else "driver"),
+                    worker_id=self.worker_id.hex())
+                self.conn.send((P.STACK_REPLY, (payload, dump)))
+            except Exception:   # noqa: BLE001 — debugging is best-effort
+                pass
+        elif op == P.PROFILE_START:
+            # guarded like STACK_DUMP: an exception here (malformed
+            # payload, can't-start-thread) would kill this process's
+            # only message-receive loop
+            try:
+                token, opts = payload
+                from . import debugging
+                debugging.profile_async(self.conn, token,
+                                        dict(opts or {}),
+                                        worker_id=self.worker_id.hex())
+            except Exception:   # noqa: BLE001 — debugging is best-effort
+                pass
         elif op == P.EVENT:
             channel, data = payload
             if channel == "LOG" and self.kind == P.KIND_DRIVER:
@@ -727,6 +752,20 @@ class CoreClient:
     def state_query(self, what: str, filters=None) -> Any:
         return self._request(P.STATE_QUERY,
                              lambda rid: (rid, what, filters)).result()
+
+    def cluster_stacks(self, timeout_s: float = 5.0) -> Any:
+        """Thread dumps of every node/worker/driver process, aggregated
+        and deduplicated by the control plane (reference: `ray stack`)."""
+        return self._request(
+            P.CLUSTER_STACKS,
+            lambda rid: (rid, timeout_s)).result(timeout=timeout_s + 30.0)
+
+    def cluster_profile(self, opts: dict) -> Any:
+        """Cluster-wide sampling profile; blocks for the duration."""
+        duration = float(opts.get("duration_s", 5.0))
+        return self._request(
+            P.CLUSTER_PROFILE,
+            lambda rid: (rid, dict(opts))).result(timeout=duration + 60.0)
 
     def create_placement_group(self, spec: P.PlacementGroupSpec):
         return self._request(P.CREATE_PG, lambda rid: (rid, spec)).result()

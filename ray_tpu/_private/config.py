@@ -143,6 +143,23 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                             "max distinct (name, tags) series the control "
                             "plane keeps; excess series are dropped and "
                             "counted"),
+    # --- debugging / stall detection ---
+    "stall_detector_interval_s": (float, 5.0,
+                                  "control-plane stall sweep period; "
+                                  "0 disables the detector"),
+    "stall_pending_threshold_s": (float, 30.0,
+                                  "warn (TASK_STALL event, with a "
+                                  "diagnosed cause) when a task sits in "
+                                  "a pending state this long; 0 disables"),
+    "stall_running_threshold_s": (float, 300.0,
+                                  "warn when a task has been RUNNING "
+                                  "this long; 0 disables"),
+    "profiler_max_duration_s": (float, 60.0,
+                                "hard cap on one `rtpu profile` "
+                                "sampling run"),
+    "profiler_default_interval_ms": (int, 10,
+                                     "default sampling period of the "
+                                     "wall-clock profiler"),
     # --- protocol ---
     "rpc_inline_chunk_bytes": (int, 1 << 20, "frame chunking for large messages"),
     "object_transfer_chunk_bytes": (int, 8 << 20,

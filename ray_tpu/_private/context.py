@@ -11,6 +11,9 @@ current_client: Optional[Any] = None
 
 # Set inside a worker process while executing a task.
 current_task_id = None
+# Display name of the running task (spec.name): read by the sampling
+# profiler's task_filter — best-effort under max_concurrency>1.
+current_task_name = None
 current_actor_id = None
 current_accel_ids = None        # TPU slot indices assigned at dispatch
 in_worker: bool = False

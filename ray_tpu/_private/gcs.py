@@ -102,6 +102,37 @@ class TaskEvent:
     node_id: Optional[NodeID]
     timestamp: float
     is_actor_task: bool = False
+    # diagnosis inputs for the stall detector: the task's resource
+    # demand, its target actor, and (for a dep-waiting task) the
+    # object ids it still needs
+    resources: Optional[Dict[str, float]] = None
+    actor_id: Optional[ActorID] = None
+    pending_args: Optional[List[ObjectID]] = None
+
+
+def aggregate_stacks(per_node: Dict[str, List[dict]]) -> List[dict]:
+    """Dedup a cluster stack collection: threads with byte-identical
+    stacks collapse into one group (at 100+ workers most are parked in
+    the same few loops — the interesting stack is the one that differs).
+    Sorted most-common first."""
+    groups: Dict[tuple, dict] = {}
+    for node_hex, dumps in (per_node or {}).items():
+        for dump in dumps or []:
+            for th in dump.get("threads", ()):
+                key = tuple(th.get("frames", ()))
+                g = groups.get(key)
+                if g is None:
+                    g = groups[key] = {"frames": list(key), "count": 0,
+                                       "threads": []}
+                g["count"] += 1
+                g["threads"].append({
+                    "node": node_hex,
+                    "kind": dump.get("kind"),
+                    "pid": dump.get("pid"),
+                    "worker_id": dump.get("worker_id"),
+                    "thread": th.get("thread_name"),
+                })
+    return sorted(groups.values(), key=lambda g: -g["count"])
 
 
 class _CompactingStorage:
@@ -214,6 +245,10 @@ class GlobalControlPlane:
         # specs of restartable actors whose node died, awaiting a
         # claimant (see claim_actor_reroute)
         self._actor_reroutes: Dict[ActorID, Any] = {}
+        # stall detector state: last sweep time + cause already warned
+        # per task (re-warn only when the diagnosed cause changes)
+        self._stall_last_sweep = 0.0
+        self._stall_warned: Dict[TaskID, str] = {}
         self._restore()
 
     # ------------------------------------------------------- persistence
@@ -834,6 +869,142 @@ class GlobalControlPlane:
     def pgs_snapshot(self) -> List[Tuple[PlacementGroupID, dict]]:
         with self._lock:
             return list(self.placement_groups.items())
+
+    # ------------------------------------------------------- stall detector
+    # Reference analogue: the task-event stall warnings GcsTaskManager
+    # derives from tasks stuck in a non-terminal state. The sweep runs
+    # on the plane (it owns every diagnosis input: task events, the
+    # directory, actor states, per-node availability) and is triggered
+    # from the hosting node's tick; emission goes through that node's
+    # EventLogger so stalls land in the events JSONL AND the ring.
+
+    _STALL_PENDING_STATES = ("PENDING_ARGS_AVAIL",
+                             "PENDING_NODE_ASSIGNMENT")
+
+    def maybe_sweep_stalls(self) -> List[dict]:
+        """Rate-limited sweep: flag tasks sitting in a pending state (or
+        RUNNING) past the configured thresholds, each with a diagnosed
+        *cause* — unsatisfiable resource shape, a never-ready dependency,
+        a dead target actor, or plain queue saturation. Returns the
+        newly-diagnosed records; the caller emits them as WARNING
+        cluster events."""
+        interval = CONFIG.stall_detector_interval_s
+        if interval <= 0:
+            return []
+        now = time.time()
+        out: List[dict] = []
+        with self._lock:
+            if now - self._stall_last_sweep < interval:
+                return []
+            self._stall_last_sweep = now
+            latest: Dict[TaskID, TaskEvent] = {}
+            for ev in self.task_events:
+                latest[ev.task_id] = ev
+            # entries for tasks evicted from the ring must not leak
+            for tid in [t for t in self._stall_warned if t not in latest]:
+                del self._stall_warned[tid]
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.resources_total.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in (n.resources_available or {}).items():
+                    avail[k] = avail.get(k, 0.0) + v
+            n_pending = sum(1 for ev in latest.values()
+                            if ev.state in self._STALL_PENDING_STATES)
+            for tid, ev in latest.items():
+                if ev.state in self._STALL_PENDING_STATES:
+                    threshold = CONFIG.stall_pending_threshold_s
+                elif ev.state == "RUNNING":
+                    threshold = CONFIG.stall_running_threshold_s
+                else:
+                    self._stall_warned.pop(tid, None)
+                    continue
+                age = now - ev.timestamp
+                if threshold <= 0 or age < threshold:
+                    continue
+                cause, message = self._diagnose_stall_locked(
+                    ev, total, avail, n_pending, age, latest)
+                if self._stall_warned.get(tid) == cause:
+                    continue
+                self._stall_warned[tid] = cause
+                out.append({"message": message,
+                            "task_id": tid.hex(),
+                            "task_name": ev.name,
+                            "task_state": ev.state,
+                            "age_s": round(age, 1),
+                            "cause": cause})
+        return out
+
+    def _diagnose_stall_locked(self, ev: TaskEvent, total: dict,
+                               avail: dict, n_pending: int, age: float,
+                               latest: Dict[TaskID, TaskEvent],
+                               ) -> Tuple[str, str]:
+        """Order matters: the most specific verifiable cause wins."""
+        missing = [oid for oid in (ev.pending_args or ())
+                   if oid not in self.directory]
+        if missing:
+            # an object whose producing task is still live is upstream
+            # slowness, not loss — only claim "never created"/"lost"
+            # when NO live producer exists for any missing dep
+            for oid in missing:
+                spec = self.lineage.get(oid)
+                pev = latest.get(spec.task_id) if spec is not None else None
+                if pev is not None and pev.state not in ("FINISHED",
+                                                         "FAILED"):
+                    return ("slow_producer",
+                            f"task {ev.name!r} has waited {age:.0f}s for "
+                            f"object {oid.hex()[:12]} still being "
+                            f"produced by task {spec.name!r} "
+                            f"({pev.state}) — upstream slowness, not "
+                            "loss")
+            never = [o for o in missing if o not in self._sealed_once]
+            what = "never created" if never else "lost"
+            oids = ", ".join(o.hex()[:12] for o in missing[:4])
+            recon = ("" if any(o in self.lineage for o in missing)
+                     else " and cannot be reconstructed (no lineage)")
+            return ("blocked_object",
+                    f"task {ev.name!r} has waited {age:.0f}s for "
+                    f"object(s) {oids} that were {what}{recon}")
+        res = ev.resources or {}
+        if res:
+            # per-NODE feasibility, not the summed cluster total: a
+            # {CPU: 3} task on two 2-CPU nodes fits the sum but no node,
+            # and will never schedule (matches scheduler.pick_node)
+            alive = [n for n in self.nodes.values() if n.alive]
+            fits_some = any(
+                all(n.resources_total.get(k, 0.0) >= v
+                    for k, v in res.items())
+                for n in alive)
+            if not fits_some:
+                biggest = {k: max((n.resources_total.get(k, 0.0)
+                                   for n in alive), default=0.0)
+                           for k in res}
+                return ("unsatisfiable_resources",
+                        f"task {ev.name!r} demands {res} but no single "
+                        f"node can satisfy it (largest per-resource "
+                        f"capacities {biggest}, cluster total "
+                        f"{ {k: total.get(k, 0.0) for k in res} }) — it "
+                        "will never schedule")
+        if ev.is_actor_task and ev.actor_id is not None:
+            rec = self.actors.get(ev.actor_id)
+            if rec is not None and rec.state == ACTOR_DEAD:
+                reason = rec.death_reason or "no reason recorded"
+                return ("actor_dead",
+                        f"call {ev.name!r} targets dead actor "
+                        f"{ev.actor_id.hex()[:12]} ({reason})")
+        if ev.state == "RUNNING":
+            return ("slow_running",
+                    f"task {ev.name!r} has been RUNNING for {age:.0f}s "
+                    "— inspect worker stacks with `rtpu stack` or "
+                    "`rtpu profile`")
+        return ("queue_saturation",
+                f"task {ev.name!r} has been queued {age:.0f}s; its shape "
+                f"fits the cluster but capacity hasn't freed (available "
+                f"{avail}, {n_pending} task(s) pending) — queue "
+                "saturation")
 
     # ------------------------------------------------------------- events
     def record_task_event(self, ev: TaskEvent) -> None:

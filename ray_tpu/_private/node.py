@@ -23,6 +23,7 @@ import sys
 import threading
 import time
 from collections import OrderedDict, deque
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -372,10 +373,12 @@ class _RemotePeer:
         except Exception:
             return None
 
-    def node_stats(self, what: str) -> Any:
+    def node_stats(self, what, timeout: Optional[float] = None) -> Any:
+        # debug collections ("stacks"/"profile" tuples) pass their own
+        # timeout: a profile's duration can exceed the lease timeout
         try:
             return self._chan.request(P.NODE_STATS, lambda r: (r, what),
-                                      timeout=self._timeout)
+                                      timeout=timeout or self._timeout)
         except Exception:
             return None
 
@@ -523,6 +526,14 @@ class NodeService:
         # structured lifecycle events (reference: src/ray/util/event.h)
         self.events = events.EventLogger(session_dir, self.node_id.hex(),
                                          gcs=gcs)
+
+        # in-flight debug collections (stack dumps / profiles): token ->
+        # Future resolved by STACK_REPLY/PROFILE_REPORT on the replying
+        # connection's reader thread — never the dispatcher, so a stack
+        # request cannot deadlock against task handling
+        self._debug_lock = threading.Lock()
+        self._debug_futures: Dict[int, Future] = {}
+        self._next_debug_token = 1
 
         self._rng = random.Random(self.node_id.binary())
 
@@ -807,9 +818,27 @@ class NodeService:
         self._check_memory_pressure()
         self._retry_infeasible()
         self._spill_starved_pending()
+        self._sweep_stalls()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
         self._dispatch()
+
+    def _sweep_stalls(self) -> None:
+        """Trigger the control plane's stall detector. Only nodes
+        hosting the plane in-process run it (in a networked cluster
+        that's the head; remote nodes triggering over RPC would just
+        race the head's sweep). The plane self-rate-limits, so the
+        in-process multi-node case — every node sharing one plane —
+        still sweeps once per interval."""
+        if not isinstance(self.gcs, GlobalControlPlane):
+            return
+        try:
+            stalls = self.gcs.maybe_sweep_stalls()
+        except Exception:   # noqa: BLE001 — diagnosis must not kill ticks
+            return
+        for rec in stalls:
+            self.events.warning("TASK_STALL",
+                                rec.pop("message", "task stalled"), **rec)
 
     def _check_memory_pressure(self) -> None:
         """Kill one worker per check while above the usage threshold
@@ -900,6 +929,7 @@ class NodeService:
             elif now < deadline:
                 self._infeasible.append((deadline, kind, spec))
             elif kind == "task":
+                self._record_event(spec, "FAILED")
                 self._fail_returns(spec, RuntimeError(
                     f"no feasible node for resources {spec.resources} "
                     f"within {CONFIG.infeasible_task_grace_s}s"))
@@ -930,7 +960,13 @@ class NodeService:
                              P.OBJ_PULL_CHUNK, P.PG_RESERVE,
                              P.PG_RELEASE, P.NODE_STATS, P.ALLOC_OBJECT,
                              P.PUT_OBJECT, P.PUT_OBJECT_SYNC,
-                             P.PUT_OBJECT_WIRE})
+                             P.PUT_OBJECT_WIRE,
+                             # debug plane: replies resolve futures and
+                             # collection requests spawn their own
+                             # thread, so neither may queue behind (or
+                             # block) the dispatcher
+                             P.STACK_REPLY, P.PROFILE_REPORT,
+                             P.CLUSTER_STACKS, P.CLUSTER_PROFILE})
 
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
@@ -949,7 +985,9 @@ class NodeService:
                     op, payload = msg
                     if op in (P.OBJ_GET_META, P.OBJ_PULL_CHUNK,
                               P.PG_RESERVE, P.NODE_STATS,
-                              P.ALLOC_OBJECT) and isinstance(payload, tuple):
+                              P.ALLOC_OBJECT, P.CLUSTER_STACKS,
+                              P.CLUSTER_PROFILE) and isinstance(payload,
+                                                                tuple):
                         result = False if op == P.PG_RESERVE else None
                         self._reply(key, P.INFO_REPLY,
                                     (payload[0], result))
@@ -984,7 +1022,32 @@ class NodeService:
             self.release_bundle(tuple(payload))
         elif op == P.NODE_STATS:
             req_id, what = payload
-            self._reply(key, P.INFO_REPLY, (req_id, self.node_stats(what)))
+            if isinstance(what, tuple):
+                # debug collections ("stacks"/"profile") block for up to
+                # their timeout waiting on worker replies; a dedicated
+                # thread keeps this peer channel's reader serving object
+                # pulls meanwhile
+                self._spawn_debug_reply(key, req_id,
+                                        lambda w=what: self.node_stats(w))
+            else:
+                self._reply(key, P.INFO_REPLY,
+                            (req_id, self.node_stats(what)))
+        elif op in (P.STACK_REPLY, P.PROFILE_REPORT):
+            token, data = payload
+            with self._debug_lock:
+                fut = self._debug_futures.pop(token, None)
+            if fut is not None and not fut.done():
+                fut.set_result(data)
+        elif op == P.CLUSTER_STACKS:
+            req_id, timeout_s = payload
+            self._spawn_debug_reply(
+                key, req_id,
+                lambda t=timeout_s: self.cluster_stacks(float(t)))
+        elif op == P.CLUSTER_PROFILE:
+            req_id, opts = payload
+            self._spawn_debug_reply(
+                key, req_id,
+                lambda o=opts: self.cluster_profile(dict(o or {})))
         elif op == P.ALLOC_OBJECT:
             req_id, oid, size = payload
             try:
@@ -1030,8 +1093,17 @@ class NodeService:
             else:
                 self._reply(key, P.PUT_REPLY, (req_id,))
 
-    def node_stats(self, what: str) -> Any:
-        """Cross-thread node introspection (also served to peers)."""
+    def node_stats(self, what) -> Any:
+        """Cross-thread node introspection (also served to peers).
+        Tuple forms carry arguments: ``("stacks", timeout_s)`` and
+        ``("profile", opts)`` are this node's debug-collection surface
+        for remote peers."""
+        if isinstance(what, tuple) and what:
+            if what[0] == "stacks":
+                return self.collect_local_stacks(float(what[1]))
+            if what[0] == "profile":
+                return self.collect_local_profile(dict(what[1] or {}))
+            return None
         if what == "available":
             return self.available_snapshot()
         if what == "store":
@@ -1053,6 +1125,162 @@ class NodeService:
         if what == "memory":
             return self._memory_monitor.snapshot()
         return None
+
+    # -------------------------------------------- debugging & profiling
+    # Reference analogues: `ray stack` (py-spy over every worker pid)
+    # and the profiling hooks. Here: STACK_DUMP/PROFILE_START frames fan
+    # out to every locally-connected worker/driver; replies resolve
+    # futures on each connection's reader thread, so a process blocked
+    # in user code (even in get()) still reports.
+
+    def _spawn_debug_reply(self, key: int, req_id: int, fn) -> None:
+        """Serve a blocking debug collection off the reader thread."""
+        def run():
+            try:
+                result = fn()
+            except Exception:   # noqa: BLE001 — debugging is best-effort
+                result = None
+            self._reply(key, P.INFO_REPLY, (req_id, result))
+        threading.Thread(target=run, daemon=True,
+                         name="rtpu-debug-collect").start()
+
+    def _debug_fanout(self, targets: List[tuple], op: int,
+                      make_payload) -> List[tuple]:
+        """Send one debug frame per target conn; returns [(future,
+        extra), ...] for the sends that left."""
+        waits = []
+        for conn, extra in targets:
+            with self._debug_lock:
+                token = self._next_debug_token
+                self._next_debug_token += 1
+                fut: Future = Future()
+                self._debug_futures[token] = fut
+            try:
+                conn.send((op, make_payload(token)))
+            except OSError:
+                with self._debug_lock:
+                    self._debug_futures.pop(token, None)
+                continue
+            waits.append((token, fut, extra))
+        return waits
+
+    def _debug_collect(self, waits: List[tuple],
+                       timeout_s: float) -> List[Any]:
+        out = []
+        deadline = time.monotonic() + timeout_s
+        for token, fut, extra in waits:
+            try:
+                data = fut.result(
+                    timeout=max(0.05, deadline - time.monotonic()))
+            except Exception:   # timeout / conn died mid-collection
+                with self._debug_lock:
+                    self._debug_futures.pop(token, None)
+                continue
+            if isinstance(data, dict):
+                for k, v in extra.items():
+                    data.setdefault(k, v)
+                out.append(data)
+        return out
+
+    def collect_local_stacks(self, timeout_s: float = 2.0) -> List[dict]:
+        """Thread dumps of this node process + every locally-connected
+        worker and driver."""
+        from . import debugging
+        node_hex = self.node_id.hex()[:12]
+        dumps = [debugging.collect_stack_dump(kind="node",
+                                              node_id=node_hex)]
+        targets = []
+        for w in list(self._workers.values()):
+            if w.conn is not None:
+                targets.append((w.conn, {"node_id": node_hex}))
+        for key in list(self._driver_conn_keys):
+            conn = self._conns.get(key)
+            if conn is not None:
+                targets.append((conn, {"node_id": node_hex}))
+        waits = self._debug_fanout(targets, P.STACK_DUMP, lambda t: t)
+        dumps.extend(self._debug_collect(waits, timeout_s))
+        return dumps
+
+    def collect_local_profile(self, opts: dict) -> List[dict]:
+        """Start the sampling profiler in every local worker; block
+        until their reports arrive (bounded by the capped duration)."""
+        duration = min(float(opts.get("duration_s") or 5.0),
+                       CONFIG.profiler_max_duration_s)
+        opts = {**opts, "duration_s": duration}
+        opts.setdefault("interval_ms", CONFIG.profiler_default_interval_ms)
+        node_hex = self.node_id.hex()[:12]
+        targets = [(w.conn, {"node_id": node_hex,
+                             "worker_id": w.worker_id.hex()})
+                   for w in list(self._workers.values())
+                   if w.conn is not None]
+        waits = self._debug_fanout(targets, P.PROFILE_START,
+                                   lambda t: (t, opts))
+        return self._debug_collect(waits, duration + 10.0)
+
+    def _collect_nodes_debug(self, what: tuple,
+                             timeout_s: float) -> Dict[str, Any]:
+        """Fan a debug collection out to every alive node (in-process
+        shortcut or peer RPC) CONCURRENTLY: sequential collection would
+        stack per-node timeouts AND give each node a disjoint sampling
+        window — cross-node straggler comparison needs one window."""
+        results: Dict[str, Any] = {}
+
+        def one(info, hexid):
+            try:
+                results[hexid] = self._peer_stats(
+                    info, what, timeout=timeout_s + 15.0)
+            except Exception:   # noqa: BLE001 — a dead peer is a gap
+                results[hexid] = None
+
+        threads = []
+        for info in self.gcs.alive_nodes():
+            hexid = info.node_id.hex()[:12]
+            results[hexid] = None    # visible even if its thread hangs
+            t = threading.Thread(target=one, args=(info, hexid),
+                                 daemon=True, name="rtpu-debug-node")
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + timeout_s + 20.0
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        return results
+
+    def cluster_stacks(self, timeout_s: float = 5.0) -> dict:
+        """Cluster-wide `rtpu stack`: every node's dumps, deduplicated
+        by the control plane (``gcs.aggregate_stacks``)."""
+        from .gcs import aggregate_stacks
+        per_node = {hexid: dumps or []
+                    for hexid, dumps in self._collect_nodes_debug(
+                        ("stacks", timeout_s), timeout_s).items()}
+        n_procs = sum(len(d) for d in per_node.values())
+        self.events.info("DEBUG_STACKS",
+                         "collected cluster-wide stack dump",
+                         nodes=len(per_node), processes=n_procs)
+        return {"nodes": per_node, "groups": aggregate_stacks(per_node)}
+
+    def cluster_profile(self, opts: dict) -> dict:
+        """Cluster-wide sampling profile: every node's worker reports
+        plus merged collapsed stacks. All nodes sample the SAME window
+        (concurrent fan-out)."""
+        from . import debugging
+        duration = min(float(opts.get("duration_s") or 5.0),
+                       CONFIG.profiler_max_duration_s)
+        per_node = {}
+        reports: List[dict] = []
+        for hexid, reps in self._collect_nodes_debug(
+                ("profile", {**opts, "duration_s": duration}),
+                duration + 15.0).items():
+            per_node[hexid] = reps or []
+            reports.extend(reps or [])
+        collapsed = debugging.merge_collapsed(reports)
+        self.events.info("DEBUG_PROFILE",
+                         "collected cluster-wide sampling profile",
+                         duration_s=duration, workers=len(reports),
+                         stacks=len(collapsed))
+        return {"nodes": per_node, "collapsed": collapsed,
+                "duration_s": duration,
+                "num_samples": sum(r.get("num_samples", 0)
+                                   for r in reports)}
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -1390,7 +1618,12 @@ class NodeService:
                                      cands, self.node_id, self._rng)
         owned = self._owned.get(spec.task_id)
         if target is None:
-            if not self._park_infeasible("task", spec):
+            if self._park_infeasible("task", spec):
+                # visible to the state API and the stall detector, which
+                # diagnoses the unsatisfiable-shape cause from the
+                # resources carried in the event
+                self._record_event(spec, "PENDING_NODE_ASSIGNMENT")
+            else:
                 self._fail_returns(spec, RuntimeError(
                     f"no feasible node for resources {spec.resources}"))
             return
@@ -1442,11 +1675,14 @@ class NodeService:
         if isinstance(strategy, sched.PlacementGroupSchedulingStrategy):
             rec.pg_key = (strategy.pg_id(),
                           max(strategy.placement_group_bundle_index, 0))
-        self._record_event(spec, "PENDING_ARGS_AVAIL")
-        # resolve dependencies
+        # resolve dependencies first so the event carries the unmet ones
+        # (the stall detector diagnoses "blocked on a never-ready
+        # object" from exactly this field)
         for slot, val in list(spec.args) + list(spec.kwargs.values()):
             if slot == "r":
                 self._add_dep(rec, val)
+        self._record_event(spec, "PENDING_ARGS_AVAIL",
+                           pending_args=(list(rec.remaining_deps) or None))
         if rec.remaining_deps:
             self._waiting_deps[spec.task_id] = rec
         else:
@@ -3295,12 +3531,20 @@ class NodeService:
             if peer is not None:
                 peer.release_bundle((pg_id, idx))
 
-    def _peer_stats(self, info, what: str) -> Any:
-        """Stats from any alive node: in-process or over the wire."""
+    def _peer_stats(self, info, what,
+                    timeout: Optional[float] = None) -> Any:
+        """Stats from any alive node: in-process or over the wire.
+        ``timeout`` only applies to the wire path (debug collections
+        outlive the default lease timeout)."""
         if info.service is not None:
-            return info.service.node_stats(what)
+            return (None if info.service.dead
+                    else info.service.node_stats(what))
         peer = self._peer(info.node_id)
-        return peer.node_stats(what) if peer is not None else None
+        if peer is None:
+            return None
+        if isinstance(peer, _RemotePeer):
+            return peer.node_stats(what, timeout=timeout)
+        return peer.node_stats(what)
 
     def _cluster_info(self, what: str) -> Any:
         if what == "resources_total":
@@ -3364,11 +3608,16 @@ class NodeService:
             return self.gcs.metrics_snapshot()
         return None
 
-    def _record_event(self, spec: P.TaskSpec, state: str) -> None:
+    def _record_event(self, spec: P.TaskSpec, state: str,
+                      pending_args: Optional[List[ObjectID]] = None) -> None:
         self.gcs.record_task_event(TaskEvent(
             task_id=spec.task_id, name=spec.name, state=state,
             node_id=self.node_id, timestamp=time.time(),
-            is_actor_task=spec.actor_id is not None))
+            is_actor_task=spec.actor_id is not None,
+            # diagnosis inputs for the stall detector
+            resources=dict(spec.resources) if spec.resources else None,
+            actor_id=spec.actor_id,
+            pending_args=pending_args))
 
 
 def _user_sys_paths() -> List[str]:

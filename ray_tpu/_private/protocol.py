@@ -112,6 +112,21 @@ RETURN_REFS = 66        # worker -> node: (return_oid, [contained oids]) —
                         # refs pickled INSIDE a return; pinned until the
                         # return object is freed (sent before TASK_DONE)
 
+# Distributed debugging (reference analogues: ``ray stack`` shelling
+# py-spy over worker pids, and the profiling hooks). Collection fans
+# out over the node plane; per-process replies ride the same conn the
+# request arrived on, answered by the RECEIVER's reader thread — which
+# is never the thread blocked in user code, so a worker wedged in get()
+# still reports its stack.
+CLUSTER_STACKS = 67     # driver -> node: (req_id, timeout_s)
+                        # -> INFO_REPLY {"nodes": {...}, "groups": [...]}
+CLUSTER_PROFILE = 68    # driver -> node: (req_id, opts dict)
+                        # -> INFO_REPLY {"nodes": {...}, "collapsed": {...}}
+STACK_DUMP = 69         # node -> worker/driver push: token
+STACK_REPLY = 70        # worker/driver -> node: (token, dump dict)
+PROFILE_START = 71      # node -> worker push: (token, opts dict)
+PROFILE_REPORT = 72     # worker -> node: (token, report dict)
+
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
 GET_REPLY = 41          # (req_id, [ObjectMeta])

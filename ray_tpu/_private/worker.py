@@ -57,8 +57,10 @@ class WorkerRuntime:
         self._blocked_in_get = False
         self.client.on_worker_block = self._return_leased_tasks
         self.client.on_worker_unblock = self._on_unblock
+        # named so `rtpu stack` dumps and profiles identify task code at
+        # a glance (and the profiler's runtime-thread filter keeps it)
         self._exec_thread = threading.Thread(target=self._exec_loop,
-                                             daemon=True)
+                                             name="task-exec", daemon=True)
         self._pool: Optional[ThreadPoolExecutor] = None
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._current_task_thread: Optional[int] = None
@@ -187,6 +189,7 @@ class WorkerRuntime:
     def _run_one(self, kind: str, spec: P.TaskSpec, deps,
                  actor_spec: Optional[P.ActorSpec]) -> None:
         context.current_task_id = spec.task_id
+        context.current_task_name = spec.name
         context.current_accel_ids = spec.accel_ids
         # inherit the submitting job's namespace so nested named-actor
         # lookups/creations resolve where the driver's would (ContextVar:
@@ -215,6 +218,7 @@ class WorkerRuntime:
             self._send_done(spec, kind, None, e)
         finally:
             context.current_task_id = None
+            context.current_task_name = None
             context.current_accel_ids = None   # slot may be recycled next
             # don't leak this task's trace into spans a later codepath
             # might open on the same pool thread
@@ -234,8 +238,12 @@ class WorkerRuntime:
             import contextlib
             return contextlib.nullcontext()
         tracing.set_remote_parent(spec.trace_context or None)
+        # literal prefixes (not f"{kind}::"): the span-name registry lint
+        # (scripts/check_metrics.py) extracts them statically
         return tracing.start_span(
-            f"{kind}::{spec.name}",
+            ("task::" if kind == "task" else
+             "actor_create::" if kind == "actor_create" else
+             "actor_call::") + spec.name,
             attributes={"task_id": spec.task_id.hex()}, force=True)
 
     async def _run_async(self, spec: P.TaskSpec, deps) -> None:
@@ -243,13 +251,14 @@ class WorkerRuntime:
         # actor-wide slots: identical for every call of this actor, so
         # the module-global is safe under asyncio interleaving
         context.current_accel_ids = spec.accel_ids
+        context.current_task_name = spec.name   # best-effort (interleaved)
         # stackless span: concurrent async calls interleave on one loop
         # thread, so the thread-local span stack would mis-nest them
         from ..util import tracing
         span = None
         if tracing.enabled() or spec.trace_context is not None:
             span = tracing.begin_span(
-                f"actor_call::{spec.name}", spec.trace_context or None,
+                "actor_call::" + spec.name, spec.trace_context or None,
                 attributes={"task_id": spec.task_id.hex()})
         try:
             args, kwargs = self._load_args(spec, deps)
@@ -262,6 +271,11 @@ class WorkerRuntime:
         except BaseException as e:  # noqa: BLE001
             tracing.end_span(span, error=type(e).__name__)
             self._send_done(spec, "actor_call", None, e)
+        finally:
+            # best-effort under interleaving (another call's name may be
+            # re-set right after) — but a stale name on an IDLE worker
+            # would misattribute every filtered profile sample forever
+            context.current_task_name = None
 
     def _create_actor(self, actor_spec: P.ActorSpec, spec: P.TaskSpec,
                       deps) -> Any:

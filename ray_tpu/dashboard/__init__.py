@@ -278,6 +278,11 @@ class _Handler(JsonHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return None
+            if path == "/api/stacks":
+                # on-demand cluster thread dump (the `rtpu stack`
+                # surface); handler threads may block for the fan-out
+                return self._json(200,
+                                  {"stacks": node.cluster_stacks(3.0)})
             if path.startswith("/api/task/"):
                 # drill-down: every recorded state transition of one
                 # task (id or unique hex prefix), time-ordered

@@ -1,6 +1,8 @@
-"""Metric-registry lint: every runtime metric the code defines must be
-a valid Prometheus name AND documented in README.md's Observability
-registry — new instrumentation can't ship undocumented.
+"""Observability-registry lint: every runtime metric the code defines
+must be a valid Prometheus name AND documented in README.md's
+Observability registry; every cluster-event label and span-name prefix
+must appear in the README's event & span registry — new instrumentation
+(including the ``debug/*`` events) can't ship undocumented.
 
 Wired in as a tier-1 test (``tests/test_metric_lint.py``); also runnable
 standalone: ``python -m ray_tpu.scripts.check_metrics``.
@@ -12,7 +14,7 @@ import ast
 import os
 import re
 import sys
-from typing import Dict, List, Set
+from typing import Dict, List, Set, Tuple
 
 # Prometheus metric-name grammar (https://prometheus.io/docs/concepts/
 # data_model/) narrowed to this repo's convention: rtpu_ prefix,
@@ -21,39 +23,42 @@ from typing import Dict, List, Set
 _NAME_RE = re.compile(r"^rtpu_[a-z][a-z0-9_]*$")
 _README_NAME_RE = re.compile(r"`(rtpu_[A-Za-z0-9_:]+)`")
 
+# Cluster-event labels (UPPER_SNAKE) and span-name prefixes
+# (``lower_snake::``), validated against the README's
+# "Cluster event & span registry" section only — scanning the whole
+# README would catch unrelated backticked identifiers.
+_LABEL_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_SPAN_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*::$")
+_README_LABEL_RE = re.compile(r"`([A-Z][A-Z0-9_]+)`")
+_README_SPAN_RE = re.compile(r"`([a-z][a-z0-9_]*::)")
+_REGISTRY_HEADING = "### Cluster event & span registry"
+
 
 def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
 
-def collect_defined_metrics(pkg_dir: str) -> Dict[str, str]:
+def collect_defined_metrics(pkg_dir: str,
+                            files=None) -> Dict[str, str]:
     """All metric names registered via ``telemetry.define(kind, name,
     ...)`` anywhere under the package, mapped to the defining file."""
     out: Dict[str, str] = {}
-    for dirpath, _dirs, files in os.walk(pkg_dir):
-        for fname in files:
-            if not fname.endswith(".py"):
+    for rel, tree in (files if files is not None
+                      else _walk_files(pkg_dir)):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
                 continue
-            path = os.path.join(dirpath, fname)
-            try:
-                with open(path) as f:
-                    tree = ast.parse(f.read(), filename=path)
-            except SyntaxError:
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name != "define" or len(node.args) < 2:
                 continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                fn = node.func
-                name = (fn.attr if isinstance(fn, ast.Attribute)
-                        else fn.id if isinstance(fn, ast.Name) else None)
-                if name != "define" or len(node.args) < 2:
-                    continue
-                arg = node.args[1]
-                if (isinstance(arg, ast.Constant)
-                        and isinstance(arg.value, str)
-                        and arg.value.startswith("rtpu_")):
-                    out[arg.value] = os.path.relpath(path, pkg_dir)
+            arg = node.args[1]
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("rtpu_")):
+                out[arg.value] = rel
     return out
 
 
@@ -65,10 +70,98 @@ def readme_metric_names(readme_path: str) -> Set[str]:
         return set()
 
 
+def _walk_files(pkg_dir: str):
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (SyntaxError, OSError):
+                continue
+            yield os.path.relpath(path, pkg_dir), tree
+
+
+def collect_event_labels(pkg_dir: str, files=None) -> Dict[str, str]:
+    """Labels of every structured cluster event emitted through an
+    EventLogger (``<x>.events.info/warning/error("LABEL", ...)`` and
+    ``<x>.events.emit(sev, "LABEL", ...)``), mapped to the file."""
+    out: Dict[str, str] = {}
+    for rel, tree in (files if files is not None
+                      else _walk_files(pkg_dir)):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr == "events"):
+                continue
+            if fn.attr in ("info", "warning", "error"):
+                arg_idx = 0
+            elif fn.attr == "emit":
+                arg_idx = 1
+            else:
+                continue
+            if len(node.args) <= arg_idx:
+                continue
+            arg = node.args[arg_idx]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out[arg.value] = rel
+    return out
+
+
+def collect_span_prefixes(pkg_dir: str, files=None) -> Dict[str, str]:
+    """Span-name prefixes (``xxx::``) appearing as string constants in
+    the name argument of ``start_span``/``begin_span`` calls."""
+    out: Dict[str, str] = {}
+    for rel, tree in (files if files is not None
+                      else _walk_files(pkg_dir)):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name not in ("start_span", "begin_span"):
+                continue
+            for sub in ast.walk(node.args[0]):
+                if (isinstance(sub, ast.Constant)
+                        and isinstance(sub.value, str)
+                        and _SPAN_PREFIX_RE.match(sub.value)):
+                    out[sub.value] = rel
+    return out
+
+
+def readme_event_registry(readme_path: str) -> Tuple[Set[str], Set[str]]:
+    """(labels, span prefixes) documented in the README's
+    "Cluster event & span registry" section."""
+    try:
+        with open(readme_path) as f:
+            text = f.read()
+    except OSError:
+        return set(), set()
+    start = text.find(_REGISTRY_HEADING)
+    if start < 0:
+        return set(), set()
+    body = text[start + len(_REGISTRY_HEADING):]
+    # section ends at the next heading of any level
+    end = re.search(r"\n#{2,3} ", body)
+    if end:
+        body = body[:end.start()]
+    return (set(_README_LABEL_RE.findall(body)),
+            set(_README_SPAN_RE.findall(body)))
+
+
 def check(repo_root: str = None) -> List[str]:
     """Returns a list of problems (empty = clean)."""
     root = repo_root or _repo_root()
-    defined = collect_defined_metrics(os.path.join(root, "ray_tpu"))
+    # one walk+parse of the package, shared by all three collectors
+    files = list(_walk_files(os.path.join(root, "ray_tpu")))
+    defined = collect_defined_metrics(os.path.join(root, "ray_tpu"),
+                                      files)
     documented = readme_metric_names(os.path.join(root, "README.md"))
     problems: List[str] = []
     if not defined:
@@ -87,6 +180,44 @@ def check(repo_root: str = None) -> List[str]:
         problems.append(
             f"{name}: listed in the README registry but no "
             "telemetry.define() in ray_tpu/ registers it")
+    problems += check_events(root, files)
+    return problems
+
+
+def check_events(root: str, files=None) -> List[str]:
+    """Event-label + span-name half of the lint."""
+    pkg = os.path.join(root, "ray_tpu")
+    if files is None:
+        files = list(_walk_files(pkg))
+    labels = collect_event_labels(pkg, files)
+    spans = collect_span_prefixes(pkg, files)
+    doc_labels, doc_spans = readme_event_registry(
+        os.path.join(root, "README.md"))
+    problems: List[str] = []
+    if not labels:
+        problems.append("no EventLogger emit sites found under ray_tpu/ "
+                        "— the event scanner is broken")
+    for label, where in sorted(labels.items()):
+        if not _LABEL_RE.match(label):
+            problems.append(
+                f"{label} ({where}): event labels must be UPPER_SNAKE")
+        if label not in doc_labels:
+            problems.append(
+                f"{label} ({where}): not documented in the README.md "
+                "cluster event & span registry")
+    for label in sorted(doc_labels - set(labels)):
+        problems.append(
+            f"{label}: in the README event registry but never emitted "
+            "under ray_tpu/")
+    for prefix, where in sorted(spans.items()):
+        if prefix not in doc_spans:
+            problems.append(
+                f"span prefix {prefix!r} ({where}): not documented in "
+                "the README.md cluster event & span registry")
+    for prefix in sorted(doc_spans - set(spans)):
+        problems.append(
+            f"span prefix {prefix!r}: in the README registry but no "
+            "start_span/begin_span under ray_tpu/ uses it")
     return problems
 
 

@@ -145,6 +145,89 @@ def cmd_timeline(client, args) -> None:
     print(f"wrote {out} (open in chrome://tracing or ui.perfetto.dev)")
 
 
+def cmd_stack(client, args) -> None:
+    """Cluster-wide thread dump (reference: ``ray stack``): every
+    node/worker/driver process, deduplicated by identical stacks."""
+    from ..state import cluster_stacks
+    result = cluster_stacks(timeout_s=args.timeout)
+    if args.format == "json":
+        print(json.dumps(result, default=str, indent=2))
+        return
+    groups = result.get("groups") or []
+    n_procs = sum(len(d) for d in (result.get("nodes") or {}).values())
+    print(f"{n_procs} process(es) on {len(result.get('nodes') or {})} "
+          f"node(s), {len(groups)} distinct stack(s)\n")
+    for g in groups:
+        where = ", ".join(
+            f"{t.get('kind')}:{str(t.get('worker_id') or t.get('node'))[:8]}"
+            f"/{t.get('thread')}" for t in g["threads"][:6])
+        more = len(g["threads"]) - 6
+        if more > 0:
+            where += f", +{more} more"
+        print(f"=== {g['count']} thread(s): {where}")
+        for fr in g["frames"]:
+            print(f"    {fr}")
+        print()
+
+
+def cmd_profile(client, args) -> None:
+    """Cluster-wide sampling wall-clock profiler; prints the hottest
+    collapsed stacks and optionally writes flamegraph / Chrome files."""
+    from .._private import debugging
+    from ..state import profile
+    report = profile(duration_s=args.duration,
+                     interval_ms=args.interval_ms,
+                     task_filter=args.task_filter,
+                     collapsed_file=args.output,
+                     chrome_trace_file=args.chrome)
+    collapsed = report.get("collapsed") or {}
+    if args.format == "json":
+        print(json.dumps(report, default=str, indent=2))
+        return
+    print(f"sampled {report.get('num_samples', 0)} ticks over "
+          f"{report.get('duration_s')}s; {len(collapsed)} distinct "
+          "stack(s)\n")
+    for count, frames in debugging.top_stacks(collapsed, n=args.top):
+        print(f"--- {count} sample(s):")
+        for fr in frames:
+            print(f"    {fr}")
+        print()
+    if args.output:
+        print(f"wrote collapsed stacks to {args.output} "
+              "(feed to flamegraph.pl / speedscope)")
+    if args.chrome:
+        print(f"wrote Chrome trace to {args.chrome}")
+
+
+def cmd_doctor(client, args) -> None:
+    """Correlated cluster health report: nodes, resources, task/actor
+    rollups, stall diagnoses, recent alerts, telemetry highlights."""
+    from ..state import health_report
+    rep = health_report()
+    if args.format == "json":
+        print(json.dumps(rep, default=str, indent=2))
+        return
+    verdict = "HEALTHY" if rep["healthy"] else "UNHEALTHY"
+    print(f"cluster: {verdict}")
+    for p in rep["problems"]:
+        print(f"  ! {p}")
+    nodes = rep["nodes"]
+    print(f"nodes: {nodes['alive']} alive, {nodes['dead']} dead")
+    res = rep["resources"]
+    for k in sorted(res["total"]):
+        print(f"  {k}: {res['available'].get(k, 0.0):g} / "
+              f"{res['total'][k]:g} available")
+    print(f"tasks: {json.dumps(rep['tasks'].get('by_state', {}))}")
+    print(f"actors: {json.dumps(rep['actors'].get('by_state', {}))}")
+    if rep["metrics"]:
+        print(f"telemetry: {json.dumps(rep['metrics'])}")
+    for ev in rep["stalls"]:
+        print(f"  STALL [{ev.get('cause')}] {ev.get('message')}")
+    for ev in rep["alerts"]:
+        print(f"  {ev.get('severity')} [{ev.get('label')}] "
+              f"{ev.get('message')}")
+
+
 def cmd_start(args) -> None:
     """Start a node process: ``rtpu start --head [--gcs-port N]`` or
     ``rtpu start --address HOST:PORT`` (reference: ``ray start``,
@@ -271,6 +354,30 @@ def main(argv=None) -> None:
     sub.add_parser("memory")
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("-o", "--output")
+    p_stack = sub.add_parser("stack",
+                             help="cluster-wide thread dump (ray stack)")
+    p_stack.add_argument("--timeout", type=float, default=5.0)
+    p_stack.add_argument("--format", choices=("text", "json"),
+                         default="text")
+    p_prof = sub.add_parser("profile",
+                            help="sampling wall-clock profiler across "
+                            "all workers")
+    p_prof.add_argument("--duration", type=float, default=5.0)
+    p_prof.add_argument("--interval-ms", type=float, default=None)
+    p_prof.add_argument("--task-filter", default=None,
+                        help="only sample while a task whose name "
+                        "contains this substring is running")
+    p_prof.add_argument("--top", type=int, default=10)
+    p_prof.add_argument("-o", "--output", default=None,
+                        help="write flamegraph collapsed stacks here")
+    p_prof.add_argument("--chrome", default=None,
+                        help="write a Chrome trace JSON here")
+    p_prof.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    p_doc = sub.add_parser("doctor",
+                           help="correlated cluster health report")
+    p_doc.add_argument("--format", choices=("text", "json"),
+                       default="text")
 
     p_start = sub.add_parser("start", help="start a cluster node process")
     p_start.add_argument("--head", action="store_true")
@@ -335,7 +442,9 @@ def main(argv=None) -> None:
     try:
         {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
          "memory": cmd_memory, "timeline": cmd_timeline,
-         "metrics": cmd_metrics}[args.command](client, args)
+         "metrics": cmd_metrics, "stack": cmd_stack,
+         "profile": cmd_profile, "doctor": cmd_doctor}[args.command](
+             client, args)
     finally:
         try:
             client.close()

@@ -7,6 +7,8 @@ records straight from the control plane.
 """
 
 from .api import (  # noqa: F401
+    cluster_stacks,
+    health_report,
     list_actors,
     list_jobs,
     list_metrics,
@@ -15,6 +17,7 @@ from .api import (  # noqa: F401
     list_placement_groups,
     list_tasks,
     list_workers,
+    profile,
     summarize_actors,
     summarize_metrics,
     summarize_tasks,

@@ -220,6 +220,121 @@ def summarize_actors() -> Dict[str, Any]:
     return summarize_actor_rows(list_actors(limit=10**9))
 
 
+# ------------------------------------------------- debugging & profiling
+
+def cluster_stacks(timeout_s: float = 5.0) -> dict:
+    """Thread dumps from every live node/worker/driver process,
+    deduplicated by the control plane (reference: ``ray stack``).
+    Returns ``{"nodes": {node_hex: [dump, ...]}, "groups": [...]}``
+    where each group collapses threads with identical stacks."""
+    return _ctx.require_client().cluster_stacks(timeout_s) or {}
+
+
+def profile(duration_s: float = 5.0, interval_ms: Optional[float] = None,
+            task_filter: Optional[str] = None,
+            collapsed_file: Optional[str] = None,
+            chrome_trace_file: Optional[str] = None) -> dict:
+    """Cluster-wide sampling wall-clock profiler: every worker samples
+    its threads for ``duration_s`` (capped by ``profiler_max_duration_s``)
+    and the merged collapsed stacks come back flamegraph-ready.
+    ``task_filter`` restricts samples to moments a task whose name
+    contains the substring is running. Optionally writes a
+    ``stack count``-per-line collapsed file and/or a Chrome trace."""
+    from .._private import debugging
+    from .._private.config import CONFIG
+
+    opts: Dict[str, Any] = {
+        "duration_s": duration_s,
+        "interval_ms": interval_ms or CONFIG.profiler_default_interval_ms,
+    }
+    if task_filter:
+        opts["task_filter"] = task_filter
+    report = _ctx.require_client().cluster_profile(opts) or {}
+    if collapsed_file:
+        debugging.write_collapsed(report.get("collapsed") or {},
+                                  collapsed_file)
+    if chrome_trace_file:
+        reports = [r for reps in (report.get("nodes") or {}).values()
+                   for r in reps]
+        with open(chrome_trace_file, "w") as f:
+            json.dump(debugging.chrome_trace(reports), f)
+    return report
+
+
+def health_report() -> Dict[str, Any]:
+    """`rtpu doctor`: one correlated cluster health view — node/resource
+    state, task/actor rollups, stall diagnoses, recent WARNING/ERROR
+    events, and telemetry highlights (queue wait, store fill, dropped
+    series)."""
+    client = _ctx.require_client()
+    nodes = shape_nodes(client.cluster_info("nodes") or [])
+    total = client.cluster_info("resources_total") or {}
+    avail = client.cluster_info("resources_available") or {}
+    tasks = shape_tasks(_query("tasks"))
+    task_summary = summarize_task_rows(tasks)
+    actor_summary = summarize_actor_rows(shape_actors(_query("actors")))
+    events = _query("cluster_events") or []
+    recent = events[-500:]
+    # a stall is a problem only while its task is still non-terminal:
+    # historical TASK_STALL events for tasks that since finished/failed
+    # must not keep the doctor red for the rest of the session
+    current_state = {t["task_id"]: t["state"] for t in tasks}
+    stalls = [e for e in recent
+              if e.get("label") == "TASK_STALL"
+              and current_state.get(e.get("task_id"))
+              in ("PENDING_ARGS_AVAIL", "PENDING_NODE_ASSIGNMENT",
+                  "RUNNING")]
+    alerts = [e for e in recent
+              if e.get("severity") in ("WARNING", "ERROR")
+              and e.get("label") != "TASK_STALL"]
+
+    highlights: Dict[str, Any] = {}
+    try:
+        metrics = summarize_metrics()
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        metrics = {}
+    queue_wait = metrics.get("rtpu_scheduler_queue_wait_seconds") or {}
+    if queue_wait.get("count"):
+        highlights["queue_wait_mean_s"] = round(
+            queue_wait["sum"] / queue_wait["count"], 4)
+    fill = metrics.get("rtpu_object_store_fill_ratio") or {}
+    if "last" in fill:
+        highlights["store_fill_ratio"] = fill["last"]
+    dropped = metrics.get("rtpu_telemetry_dropped_series_total") or {}
+    if dropped.get("total"):
+        highlights["dropped_metric_series"] = dropped["total"]
+
+    dead_nodes = [n for n in nodes if not n.get("alive")]
+    by_state = task_summary.get("by_state", {})
+    n_pending = (by_state.get("PENDING_ARGS_AVAIL", 0)
+                 + by_state.get("PENDING_NODE_ASSIGNMENT", 0))
+    problems: List[str] = []
+    if dead_nodes:
+        problems.append(f"{len(dead_nodes)} node(s) dead")
+    if stalls:
+        stalled = {e.get("task_id") for e in stalls}
+        problems.append(f"{len(stalled)} stalled task(s) — see stalls")
+    errors = [e for e in alerts if e.get("severity") == "ERROR"]
+    if errors:
+        problems.append(f"{len(errors)} ERROR event(s) — see alerts")
+    cpu_avail = avail.get("CPU", 0.0)
+    if n_pending and cpu_avail <= 0:
+        problems.append(f"{n_pending} task(s) pending with 0 CPU "
+                        "available (saturated or wedged)")
+    return {
+        "healthy": not problems,
+        "problems": problems,
+        "nodes": {"alive": len(nodes) - len(dead_nodes),
+                  "dead": len(dead_nodes)},
+        "resources": {"total": total, "available": avail},
+        "tasks": task_summary,
+        "actors": actor_summary,
+        "stalls": stalls[-20:],
+        "alerts": alerts[-20:],
+        "metrics": highlights,
+    }
+
+
 def list_cluster_events(filters: Optional[dict] = None,
                         limit: int = 1000) -> List[dict]:
     """Structured lifecycle events — node up/down, OOM kills, actor

@@ -158,5 +158,10 @@ def flush() -> None:
 
 
 def _local_requeue(spans: List[dict]) -> None:
+    """Put drained-but-unshippable spans back at the buffer head. Clamp
+    to _MAX_BUFFER afterwards (dropping the OLDEST overflow): repeated
+    failed flushes must not grow the buffer without bound."""
     with _buffer_lock:
         _buffer[:0] = spans
+        if len(_buffer) > _MAX_BUFFER:
+            del _buffer[:len(_buffer) - _MAX_BUFFER]

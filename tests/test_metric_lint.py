@@ -45,3 +45,42 @@ def test_undocumented_metric_fails(tmp_path):
     (tmp_path / "README.md").write_text("# no registry here\n")
     problems = check_metrics.check(str(tmp_path))
     assert any("not documented" in p for p in problems)
+
+
+def test_scanner_sees_known_event_labels_and_spans():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(root, "ray_tpu")
+    labels = check_metrics.collect_event_labels(pkg)
+    for label in ("NODE_START", "OOM_KILL", "ACTOR_DEATH",
+                  "TASK_STALL", "DEBUG_STACKS", "DEBUG_PROFILE"):
+        assert label in labels, label
+    spans = check_metrics.collect_span_prefixes(pkg)
+    assert {"task::", "actor_create::", "actor_call::"} <= set(spans)
+
+
+def test_undocumented_event_label_fails(tmp_path):
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        'define("counter", "rtpu_ok_total", "x")\n'
+        'self.events.warning("NEW_SURPRISE", "boom")\n')
+    (tmp_path / "README.md").write_text(
+        "`rtpu_ok_total`\n\n### Cluster event & span registry\n\n"
+        "(nothing documented)\n")
+    problems = check_metrics.check(str(tmp_path))
+    assert any("NEW_SURPRISE" in p and "not documented" in p
+               for p in problems)
+
+
+def test_undocumented_span_prefix_fails(tmp_path):
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        'define("counter", "rtpu_ok_total", "x")\n'
+        'self.events.info("KNOWN", "ok")\n'
+        'tracing.start_span("mystery::" + name)\n')
+    (tmp_path / "README.md").write_text(
+        "`rtpu_ok_total`\n\n### Cluster event & span registry\n\n"
+        "`KNOWN`\n")
+    problems = check_metrics.check(str(tmp_path))
+    assert any("mystery::" in p for p in problems)

@@ -44,6 +44,44 @@ def test_list_tasks_and_actors(rtpu_init):
     assert work_counts and work_counts[0]["FINISHED"] == 3
 
 
+@ray_tpu.remote
+def golden_task():
+    time.sleep(0.02)
+    return 1
+
+
+def test_timeline_golden_file(rtpu_init, tmp_path):
+    """Golden-file pin of the ``state.timeline()`` Chrome-trace JSON:
+    event shape (name/cat/ph/args) byte-exact, variable fields (ts, dur,
+    node/task ids) normalized after type/positivity checks. Complements
+    the span-based ``trace_timeline`` coverage in
+    ``test_tracing_events.py``."""
+    import os
+
+    ray_tpu.get([golden_task.remote() for _ in range(2)])
+    out = str(tmp_path / "trace.json")
+    assert rstate.timeline(out) == out
+    with open(out) as f:
+        trace = json.load(f)
+
+    normalized = []
+    for ev in sorted(trace, key=lambda e: (e["name"], e["ts"])):
+        assert isinstance(ev["ts"], float) and ev["ts"] > 0
+        assert isinstance(ev["dur"], float) and ev["dur"] > 0
+        assert ev["pid"].startswith("node:")
+        normalized.append({
+            "name": ev["name"].rsplit(".", 1)[-1],
+            "cat": ev["cat"], "ph": ev["ph"],
+            "ts": "<ts>", "dur": "<dur>",
+            "pid": "node:<node>", "tid": "<tid>",
+            "args": ev["args"],
+        })
+    golden_path = os.path.join(os.path.dirname(__file__), "golden",
+                               "timeline.golden")
+    with open(golden_path) as f:
+        assert normalized == json.load(f)
+
+
 def test_timeline_chrome_trace(rtpu_init, tmp_path):
     @ray_tpu.remote
     def slow():

@@ -108,6 +108,41 @@ def test_trace_timeline_export(traced_init, tmp_path):
     assert any(e["name"] == "task::child_task" for e in events)
 
 
+def test_local_requeue_clamps_buffer():
+    """Re-queuing drained spans (no client to flush to) must clamp to
+    _MAX_BUFFER, dropping the oldest overflow — repeated failed flushes
+    must not grow the buffer without bound."""
+    tracing.drain()
+    try:
+        spans = [{"name": str(i)}
+                 for i in range(tracing._MAX_BUFFER + 500)]
+        tracing._local_requeue(spans)
+        assert len(tracing._buffer) == tracing._MAX_BUFFER
+        # newest spans survive; the oldest 500 were dropped
+        assert tracing._buffer[-1]["name"] == str(
+            tracing._MAX_BUFFER + 499)
+        assert tracing._buffer[0]["name"] == "500"
+    finally:
+        tracing.drain()
+
+
+def test_repeated_failed_flush_stays_bounded(monkeypatch):
+    from ray_tpu._private import context as ctx
+
+    monkeypatch.setattr(ctx, "current_client", None)   # no transport
+    monkeypatch.setattr(tracing, "_MAX_BUFFER", 100)
+    tracing.drain()
+    try:
+        for i in range(80):
+            tracing._record({"name": f"s{i}"})
+        for _ in range(20):
+            tracing.flush()        # drain -> no client -> requeue
+            tracing._record({"name": "extra"})
+        assert len(tracing._buffer) == 100
+    finally:
+        tracing.drain()
+
+
 def test_cluster_events_node_start_and_actor_death(rtpu_init):
     events = state_api.list_cluster_events()
     assert any(e["label"] == "NODE_START" for e in events)
