@@ -85,6 +85,121 @@ def bench_profiled_spin(spin) -> tuple:
     return elapsed, (out.get("report") or {}).get("num_samples", 0)
 
 
+def _rtt_measure(send_one, n: int) -> float:
+    """Min-of-rounds RTT of ``n`` ping-pongs (seconds/msg)."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            send_one()
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best
+
+
+class _SeedConnection:
+    """The pre-batching transport, verbatim (per-message pickle +
+    header-concat copy + locked ``sendall``; copy-per-read receive) —
+    the regression baseline the batched ``Connection`` must not lose
+    to on single messages."""
+
+    import pickle as _pickle
+    import struct as _struct
+    _LEN = _struct.Struct("<I")
+
+    def __init__(self, sock):
+        import threading
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_buf = bytearray()
+
+    def send(self, msg):
+        data = self._pickle.dumps(msg, protocol=5)
+        frame = self._LEN.pack(len(data)) + data
+        with self._send_lock:
+            self._sock.sendall(frame)
+
+    def recv(self):
+        header = self._recv_exact(self._LEN.size)
+        if header is None:
+            return None
+        (length,) = self._LEN.unpack(header)
+        body = self._recv_exact(length)
+        if body is None:
+            return None
+        return self._pickle.loads(body)
+
+    def _recv_exact(self, n):
+        buf = self._recv_buf
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(max(n - len(buf), 1 << 16))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        out = bytes(buf[:n])
+        del buf[:n]
+        return out
+
+    def close(self):
+        import socket
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def _rtt_one(make_conn, msg, n: int) -> float:
+    """Min-of-rounds ping-pong RTT through one transport flavor."""
+    import socket
+    import threading
+
+    sa, sb = socket.socketpair()
+    ca, cb = make_conn(sa), make_conn(sb)
+
+    def echo():
+        while True:
+            m = cb.recv()
+            if m is None:
+                return
+            cb.send(m)
+
+    et = threading.Thread(target=echo, daemon=True)
+    et.start()
+
+    def ping():
+        ca.send(msg)
+        ca.recv()
+
+    for _ in range(50):
+        ping()               # warm the path
+    best = _rtt_measure(ping, n)
+    ca.close()
+    cb.close()
+    et.join(timeout=5)
+    return best
+
+
+def transport_rtt() -> tuple:
+    """Single-message (unbatched) round-trip through the batched
+    ``Connection`` vs the seed transport's per-message
+    pickle+``sendall`` shape — the coalescing machinery must cost
+    ~nothing when there is nothing to coalesce. Interleaved rounds,
+    min of all: this box's syscall cost swings 4x with scheduling, so
+    same-phase comparisons flake. Returns (conn_rtt_s, seed_rtt_s)."""
+    from ray_tpu._private import protocol as P
+
+    n = 300
+    msg = (P.KV_PUT, (b"bench-key", b"bench-value", True))
+    conn_s = seed_s = float("inf")
+    for _ in range(4):
+        conn_s = min(conn_s, _rtt_one(P.Connection, msg, n))
+        seed_s = min(seed_s, _rtt_one(_SeedConnection, msg, n))
+    return conn_s, seed_s
+
+
 def record_path_ns() -> float:
     """Direct cost of one counter_inc (the instrumented-path primitive)."""
     n = 100_000
@@ -151,8 +266,21 @@ def main() -> None:
         # for residual scheduler noise on a 2-core CI box, which swings
         # ±15% even at min-of-rounds. The profiler run must also have
         # actually produced samples.
+        # transport gate: batching must not tax the unbatched case. The
+        # 1.75 budget is set by the measured noise band, not the real
+        # overhead: standalone, the ratio sits at 0.64-1.05 (parity or
+        # better), but inside the full bench — after the CPU-heavy
+        # profiler phases — the same measurement swings up to ~1.5 on
+        # this box (syscall pricing varies 2x with scheduler state even
+        # at min-of-interleaved-rounds). A per-message thread handoff
+        # or an extra full-frame copy overshoots 1.75 by 2-5x
+        # regardless, which is the regression class this gate exists
+        # to catch.
+        conn_rtt_s, raw_rtt_s = transport_rtt()
+        transport_ratio = conn_rtt_s / max(raw_rtt_s, 1e-9)
         ok = (submit_ratio < 1.2 and put_ratio < 1.2 and ns < 20_000
-              and profile_ratio < 1.4 and prof_samples > 0)
+              and profile_ratio < 1.4 and prof_samples > 0
+              and transport_ratio < 1.75)
         print(json.dumps({
             "metric": "telemetry_overhead",
             "submit_on_s": round(sub_on, 4),
@@ -166,6 +294,9 @@ def main() -> None:
             "profile_on_s": round(profile_on, 4),
             "profile_ratio": round(profile_ratio, 3),
             "profile_samples": prof_samples,
+            "transport_rtt_us": round(conn_rtt_s * 1e6, 1),
+            "transport_raw_rtt_us": round(raw_rtt_s * 1e6, 1),
+            "transport_ratio": round(transport_ratio, 3),
             "pass": ok,
         }), flush=True)
     finally:

@@ -131,6 +131,10 @@ def init(address: Optional[Any] = None,
         conn = _P.connect_address(head_tcp_address)
         node_id = _NodeID.from_hex(head["node_id"])
     client = CoreClient(conn, job_id, WorkerID.from_random(), _P.KIND_DRIVER)
+    if _global_node is not None:
+        # head driver: large puts go straight to the in-process store
+        # (alloc/write/seal, no control-plane round trips)
+        client.local_node = _global_node
     if _global_node is None:
         # Ray-Client-equivalent attach: when this driver does not share
         # /dev/shm with the head node, object payloads must ride the

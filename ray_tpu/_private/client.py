@@ -42,6 +42,11 @@ class CoreClient:
         self.kind = kind
         self.node_id = None         # set by driver init / worker runtime
         self.namespace = "default"  # set by init(namespace=...)
+        # in-process NodeService when this driver runs on the head: large
+        # puts then alloc/write/seal directly against the local store —
+        # no ALLOC_OBJECT/PUT_OBJECT_SYNC round trips (reference
+        # analogue: CoreWorker's local plasma client)
+        self.local_node = None
         # Ray-Client-equivalent mode: this process shares no /dev/shm
         # with the node it is connected to, so object payloads must ride
         # the socket (set by init() when the head's host differs)
@@ -53,6 +58,7 @@ class CoreClient:
         self._futures: Dict[int, Future] = {}
         self._req_lock = threading.Lock()
         self._next_req = 1
+        conn.on_send_error = self._on_send_error
         self._registered_fns: set = set()
         self._reader_thread: Optional[threading.Thread] = None
         self._closed = threading.Event()
@@ -183,11 +189,14 @@ class CoreClient:
 
     def _read_loop(self) -> None:
         while True:
-            msg = self.conn.recv()
-            if msg is None:
+            # burst receive: the node's writer coalesces replies/pushes,
+            # so one wakeup often resolves a whole batch of futures
+            msgs = self.conn.recv_many()
+            if msgs is None:
                 self._fail_all(ConnectionError("lost connection to node"))
                 return
-            self.handle_message(*msg)
+            for msg in msgs:
+                self.handle_message(*msg)
 
     def handle_message(self, op: int, payload: Any) -> None:
         if op == P.PUT_REPLY:
@@ -304,6 +313,9 @@ class CoreClient:
         self.conn.close()
 
     # ------------------------------------------------------------- plumbing
+    def _on_send_error(self, msg, exc: BaseException) -> None:
+        P.fail_dropped_request(msg, exc, self._req_lock, self._futures)
+
     def _request(self, op: int, make_payload) -> Future:
         fut: Future = Future()
         with self._req_lock:
@@ -394,14 +406,16 @@ class CoreClient:
             if self.wire_data_plane:
                 flat = self._serialize_flat(value)
             else:
-                meta = self._store_value(oid, value)
+                meta, sealed = self._store_value(oid, value)
         finally:
             contained = end_ref_capture()
         self._pin_contained(oid, contained)
         if self.wire_data_plane:
             self._wire_put(oid, *flat)
             return ref
-        if meta.shm_name is not None or meta.arena_ref is not None:
+        if sealed:
+            pass    # adopted + published in-process (head driver)
+        elif meta.shm_name is not None or meta.arena_ref is not None:
             # Large object: block until the node store adopts it — a
             # returned ref IS sealed, matching the reference
             # (``core_worker.cc:1141``). A one-way seal was measured at
@@ -443,14 +457,45 @@ class CoreClient:
                     pass
             raise
 
-    def _store_value(self, oid: ObjectID, value: Any) -> ObjectMeta:
-        """Serialize a value; small inline, large into shm."""
+    def _store_value(self, oid: ObjectID, value: Any
+                     ) -> Tuple[ObjectMeta, bool]:
+        """Serialize a value; small inline, large into shm. Returns
+        (meta, sealed) — sealed means the local fast path already
+        adopted + published it and no PUT rpc is needed."""
         smeta, views = ser.serialize(value)
         total = ser.serialized_size(smeta, views)
         if total <= CONFIG.max_inline_object_bytes:
             return ObjectMeta(object_id=oid, size=total,
-                              inline=_flat_bytes(smeta, views, total))
-        return self.store_large(oid, smeta, views, total)
+                              inline=_flat_bytes(smeta, views, total)), False
+        meta = self._local_store_large(oid, smeta, views, total)
+        if meta is not None:
+            return meta, True
+        return self.store_large(oid, smeta, views, total), False
+
+    def _local_store_large(self, oid: ObjectID, smeta, views,
+                           total: int) -> Optional[ObjectMeta]:
+        """Head-driver fast path: the node service lives in THIS
+        process, so allocate + write + seal directly against its store
+        and publish the location — zero control-plane round trips for
+        a large put (reference analogue: local plasma client)."""
+        node = self.local_node
+        if node is None or getattr(node, "dead", False):
+            return None
+        try:
+            buf, meta = node.store.create_local(oid, total)
+        except Exception:       # store full / duplicate: RPC path decides
+            return None
+        try:
+            ser.write_to(buf, smeta, views)
+            node.store.seal(oid)
+        except BaseException:
+            # a failed fill (exporter error, KeyboardInterrupt) must not
+            # leave a permanently unsealed, budget-charged entry behind
+            del buf             # release the view before the arena/shm free
+            node.store.abort_create(oid)
+            raise
+        node._seal_object(meta)     # re-adopt no-ops; publishes location
+        return meta
 
     @staticmethod
     def _serialize_flat(value: Any) -> Tuple[bytes, int]:
@@ -459,14 +504,15 @@ class CoreClient:
         return _flat_bytes(smeta, views, total), total
 
     def _wire_put(self, oid: ObjectID, data: bytes, total: int) -> None:
-        """Cross-host put: the payload rides the socket and the NODE
-        materializes it as the primary copy (we have no shared shm)."""
+        """Cross-host put: the payload rides the socket (out-of-band as
+        a zero-copy iovec when large) and the NODE materializes it as
+        the primary copy (we have no shared shm)."""
         if total <= CONFIG.max_inline_object_bytes:
             self._send(P.PUT_OBJECT,
                        ObjectMeta(object_id=oid, size=total, inline=data))
         else:
             self._request(P.PUT_OBJECT_WIRE,
-                          lambda rid: (rid, oid, data)).result()
+                          lambda rid: (rid, oid, P.oob_wrap(data))).result()
 
     def store_large(self, oid: ObjectID, smeta, views,
                     total: int) -> ObjectMeta:
@@ -574,7 +620,32 @@ class CoreClient:
         return ready, pending
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
-        self._send(P.FREE_OBJECTS, [r.id for r in refs])
+        ids = [r.id for r in refs]
+        node = self.local_node
+        if node is not None and not getattr(node, "dead", False):
+            # head driver: free synchronously against the in-process
+            # store (mirrors the local put fast path — a put loop that
+            # frees as it goes must not outrun socket-borne frees and
+            # push the store into spilling). Only ids the store has
+            # already SEALED are eligible: an inline put rides the
+            # socket as a fire-and-forget PUT_OBJECT, and an in-process
+            # free must not overtake that queued frame (the
+            # late-arriving put would resurrect the freed object) —
+            # unsealed ids ride the same socket so the node applies put
+            # and free in order.
+            try:
+                local = [oid for oid in ids if node.store.contains(oid)]
+                if local:
+                    for oid in local:
+                        node.gcs.drop_location(oid)
+                    node.store.free(local)
+                    if len(local) == len(ids):
+                        return
+                    done = set(local)
+                    ids = [oid for oid in ids if oid not in done]
+            except Exception:   # noqa: BLE001 — fall back to the RPC
+                pass
+        self._send(P.FREE_OBJECTS, ids)
 
     def as_future(self, ref: ObjectRef) -> Future:
         out: Future = Future()
@@ -642,8 +713,10 @@ class CoreClient:
         if self.wire_data_plane:
             self._wire_put(oid, _flat_bytes(smeta, views, total), total)
             return ("r", implicit_ref.id)
-        meta = self.store_large(oid, smeta, views, total)
-        self._sync_put(meta)
+        meta = self._local_store_large(oid, smeta, views, total)
+        if meta is None:
+            meta = self.store_large(oid, smeta, views, total)
+            self._sync_put(meta)
         return ("r", implicit_ref.id)
 
     # ---------------------------------------------------------------- tasks

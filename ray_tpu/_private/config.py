@@ -160,7 +160,30 @@ _CONFIG_DEFS: Dict[str, tuple] = {
     "profiler_default_interval_ms": (int, 10,
                                      "default sampling period of the "
                                      "wall-clock profiler"),
-    # --- protocol ---
+    # --- protocol / wire transport ---
+    "socket_send_buffer_bytes": (int, 1 << 21,
+                                 "SO_SNDBUF requested for control-plane "
+                                 "sockets"),
+    "socket_recv_buffer_bytes": (int, 1 << 21,
+                                 "SO_RCVBUF requested for control-plane "
+                                 "sockets"),
+    "transport_max_batch_msgs": (int, 128,
+                                 "max messages the connection writer "
+                                 "coalesces into one BATCH frame"),
+    "transport_max_batch_bytes": (int, 1 << 20,
+                                  "approximate payload cap of one "
+                                  "coalesced BATCH frame (estimated "
+                                  "pre-pickle; large messages get their "
+                                  "own frame)"),
+    "transport_queue_depth": (int, 1024,
+                              "bounded per-connection send queue; "
+                              "producers block above this depth "
+                              "(backpressure)"),
+    "transport_oob_threshold_bytes": (int, 64 << 10,
+                                      "pickle-5 buffers >= this ship "
+                                      "out-of-band as zero-copy iovecs "
+                                      "instead of inside the pickle "
+                                      "stream"),
     "rpc_inline_chunk_bytes": (int, 1 << 20, "frame chunking for large messages"),
     "object_transfer_chunk_bytes": (int, 8 << 20,
                                     "cross-host object pulls stream in "

@@ -85,30 +85,33 @@ class GcsServer:
 
     def _serve_conn(self, key: int, conn: P.Connection) -> None:
         while True:
-            msg = conn.recv()
-            if msg is None:
+            # burst receive: a node's coalesced cast stream (heartbeats,
+            # ref edges, task events) is served per wakeup, not per frame
+            msgs = conn.recv_many()
+            if msgs is None:
                 self._on_conn_closed(key)
                 return
-            op, payload = msg
-            try:
-                if op == P.GCS_CALL:
-                    req_id, method, args, kwargs = payload
-                    try:
-                        result = self._invoke(key, method, args, kwargs)
-                        conn.send((P.INFO_REPLY, (req_id, result)))
-                    except Exception as e:  # noqa: BLE001 — caller unblocks
-                        conn.send((P.ERROR_REPLY, (req_id, ser.to_bytes(e))))
-                elif op == P.GCS_CAST:
-                    method, args, kwargs = payload
-                    try:
-                        self._invoke(key, method, args, kwargs)
-                    except Exception:
-                        pass
-                elif op == P.GCS_SUBSCRIBE:
-                    self._subscribe_conn(key, payload)
-            except OSError:
-                self._on_conn_closed(key)
-                return
+            for op, payload in msgs:
+                try:
+                    if op == P.GCS_CALL:
+                        req_id, method, args, kwargs = payload
+                        try:
+                            result = self._invoke(key, method, args, kwargs)
+                            conn.send((P.INFO_REPLY, (req_id, result)))
+                        except Exception as e:  # noqa: BLE001 — unblocks
+                            conn.send((P.ERROR_REPLY,
+                                       (req_id, ser.to_bytes(e))))
+                    elif op == P.GCS_CAST:
+                        method, args, kwargs = payload
+                        try:
+                            self._invoke(key, method, args, kwargs)
+                        except Exception:
+                            pass
+                    elif op == P.GCS_SUBSCRIBE:
+                        self._subscribe_conn(key, payload)
+                except OSError:
+                    self._on_conn_closed(key)
+                    return
 
     def _invoke(self, conn_key: int, method: str, args, kwargs) -> Any:
         if method not in _ALLOWED:

@@ -970,34 +970,45 @@ class NodeService:
 
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
-            msg = conn.recv()
-            if msg is None:
+            # burst receive: every frame the peer's writer coalesced is
+            # decoded in one wakeup; non-direct messages post to the
+            # dispatcher as ONE event so a 100-frame burst is one
+            # scheduling pass, not 100 queue round-trips
+            msgs = conn.recv_many()
+            if msgs is None:
                 self._events.put(("conn_closed", key))
                 return
-            if msg[0] in self._DIRECT_OPS:
-                try:
-                    self._handle_direct(key, *msg)
-                except Exception:
-                    import traceback
-                    traceback.print_exc(file=sys.stderr)
-                    # request-type ops carry (req_id, ...): answer so the
-                    # caller doesn't block forever / out its full timeout
-                    op, payload = msg
-                    if op in (P.OBJ_GET_META, P.OBJ_PULL_CHUNK,
-                              P.PG_RESERVE, P.NODE_STATS,
-                              P.ALLOC_OBJECT, P.CLUSTER_STACKS,
-                              P.CLUSTER_PROFILE) and isinstance(payload,
-                                                                tuple):
-                        result = False if op == P.PG_RESERVE else None
-                        self._reply(key, P.INFO_REPLY,
-                                    (payload[0], result))
-                    elif (op in (P.PUT_OBJECT_SYNC, P.PUT_OBJECT_WIRE)
-                          and isinstance(payload, tuple)):
-                        err = to_bytes(RuntimeError(
-                            "put failed on the node store"))
-                        self._reply(key, P.ERROR_REPLY, (payload[0], err))
-            else:
-                self._events.put(("msg", key, msg))
+            queued: Optional[List[tuple]] = None
+            for msg in msgs:
+                if msg[0] in self._DIRECT_OPS:
+                    try:
+                        self._handle_direct(key, *msg)
+                    except Exception:
+                        import traceback
+                        traceback.print_exc(file=sys.stderr)
+                        # request-type ops carry (req_id, ...): answer so
+                        # the caller doesn't block out its full timeout
+                        op, payload = msg
+                        if op in (P.OBJ_GET_META, P.OBJ_PULL_CHUNK,
+                                  P.PG_RESERVE, P.NODE_STATS,
+                                  P.ALLOC_OBJECT, P.CLUSTER_STACKS,
+                                  P.CLUSTER_PROFILE
+                                  ) and isinstance(payload, tuple):
+                            result = False if op == P.PG_RESERVE else None
+                            self._reply(key, P.INFO_REPLY,
+                                        (payload[0], result))
+                        elif (op in (P.PUT_OBJECT_SYNC, P.PUT_OBJECT_WIRE)
+                              and isinstance(payload, tuple)):
+                            err = to_bytes(RuntimeError(
+                                "put failed on the node store"))
+                            self._reply(key, P.ERROR_REPLY,
+                                        (payload[0], err))
+                else:
+                    if queued is None:
+                        queued = []
+                    queued.append(msg)
+            if queued:
+                self._events.put(("msgs", key, queued))
 
     def _handle_direct(self, key: int, op: int, payload: Any) -> None:
         if op == P.NODE_POST:
@@ -1011,9 +1022,12 @@ class NodeService:
             self.store.unpin(payload)
         elif op == P.OBJ_PULL_CHUNK:
             req_id, oid, offset, length = payload
-            self._reply(key, P.INFO_REPLY,
-                        (req_id,
-                         self.store.read_payload_chunk(oid, offset, length)))
+            res = self.store.read_payload_chunk(oid, offset, length)
+            if res is not None and res[1] is not None:
+                # chunk bytes ride out-of-band: straight from the store
+                # copy to the socket as an iovec, no pickle-stream copy
+                res = (res[0], P.oob_wrap(res[1]))
+            self._reply(key, P.INFO_REPLY, (req_id, res))
         elif op == P.PG_RESERVE:
             req_id, pg_key, demand = payload
             self._reply(key, P.INFO_REPLY,
@@ -1066,29 +1080,17 @@ class NodeService:
             else:
                 self._reply(key, P.PUT_REPLY, (req_id,))
         elif op == P.PUT_OBJECT_WIRE:
-            # cross-host driver put: payload arrived over the socket;
-            # materialize it in OUR store as the primary copy
+            # cross-host driver put: the payload arrived over the socket
+            # (a zero-copy out-of-band view into the frame buffer for
+            # large transfers); land it straight in an arena block /
+            # segment as the primary copy — one copy off the socket
             req_id, oid, data = payload
-            name = None
             try:
-                seg = object_store.create_segment(oid, len(data))
-                seg.buf[:len(data)] = data
-                name = seg.name
-                seg.close()
-                self._seal_object(ObjectMeta(object_id=oid,
-                                             size=len(data),
-                                             shm_name=name))
+                meta = self.store.put_payload(oid, data)
+                # adopt already ran inside put_payload; _seal_object's
+                # re-adopt is a no-op and it publishes the location
+                self._seal_object(meta)
             except Exception as e:  # noqa: BLE001 — client put() blocks
-                if name is not None:
-                    # seal rejected it: no store owns the segment, so it
-                    # would leak /dev/shm forever (and FileExistsError any
-                    # client retry of the same oid)
-                    try:
-                        seg = object_store.attach_segment(name)
-                        seg.close()
-                        seg.unlink()
-                    except Exception:   # noqa: BLE001 — best-effort
-                        pass
                 self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
             else:
                 self._reply(key, P.PUT_REPLY, (req_id,))
@@ -1320,6 +1322,8 @@ class NodeService:
         if kind == "msg":
             _, key, (op, payload) = item
             self._handle_msg(key, op, payload)
+        elif kind == "msgs":
+            self._handle_burst(item[1], item[2])
         elif kind == "conn_closed":
             self._on_conn_closed(item[1])
         elif kind == "remote_task":
@@ -1359,6 +1363,27 @@ class NodeService:
         elif kind == "timer":
             item[1]()
 
+    def _handle_burst(self, key: int, msgs: List[tuple]) -> None:
+        """One receive burst from one connection, handled with a single
+        scheduling pass at the end (mirrors SUBMIT_BATCH): a burst of
+        TASK_DONEs frees N workers then dispatches once, not N times."""
+        if len(msgs) == 1:
+            self._handle_msg(key, *msgs[0])
+            return
+        prev = self._in_batch
+        self._in_batch = True
+        try:
+            for op, payload in msgs:
+                try:
+                    self._handle_msg(key, op, payload)
+                except Exception:
+                    import traceback
+                    traceback.print_exc(file=sys.stderr)
+        finally:
+            self._in_batch = prev
+        if not self._in_batch:
+            self._dispatch()
+
     def _handle_msg(self, key: int, op: int, payload: Any) -> None:
         if op == P.REGISTER:
             kind, worker_id, pid = payload
@@ -1384,14 +1409,18 @@ class NodeService:
             self._submit_task(payload)
         elif op == P.SUBMIT_BATCH:
             # coalesced submissions: queue them all, then dispatch once —
-            # a 100-task burst is one scheduling pass, not 100
+            # a 100-task burst is one scheduling pass, not 100.
+            # Save/restore: this frame may itself arrive inside a
+            # transport burst (_handle_burst) that defers the dispatch.
+            prev = self._in_batch
             self._in_batch = True
             try:
                 for sub_op, spec in payload:
                     self._handle_msg(key, sub_op, spec)
             finally:
-                self._in_batch = False
-            self._dispatch()
+                self._in_batch = prev
+            if not self._in_batch:
+                self._dispatch()
         elif op == P.CREATE_ACTOR:
             self._create_actor(payload)
         elif op == P.SUBMIT_ACTOR_TASK:
@@ -2507,7 +2536,8 @@ class NodeService:
         w = self._workers.get(rec.worker_id) if rec.worker_id else None
         if rec.kind == "actor_create":
             self._actor_creation_done(rec, error)
-            self._dispatch()
+            if not self._in_batch:      # a burst dispatches once, at end
+                self._dispatch()
             return
         if rec.kind == "task" and w is not None and w.pipeline:
             # leased pipeline: hand the charge to the next task of the
@@ -2525,7 +2555,8 @@ class NodeService:
                 self._mark_idle(w)
         if rec.kind == "actor_call" and w is not None:
             w.task = None
-        self._dispatch()
+        if not self._in_batch:          # a burst dispatches once, at end
+            self._dispatch()
 
     def _seal_object(self, meta: ObjectMeta) -> None:
         self.store.adopt(meta)

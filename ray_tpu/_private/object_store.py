@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import pickle
 import threading
 import time
 from collections import OrderedDict
@@ -107,13 +108,27 @@ class ObjectMeta:
     # arena (plasma-style Create/Seal; ``native/object_arena.cpp``)
     arena_ref: Optional[tuple] = None
 
-    def __reduce__(self):
+    def __reduce_ex__(self, protocol):
         # hot-path pickle: metas ride every TASK_DONE / GET_REPLY /
         # dispatch frame; flat tuple with the id as raw bytes is ~4x
         # cheaper than the default dataclass reduce (see
-        # TaskSpec.__reduce__ for the measurement)
+        # TaskSpec.__reduce__ for the measurement). Large inline
+        # payloads wrap in a PickleBuffer so the transport ships them
+        # out-of-band as iovecs (zero copy through the pickle stream);
+        # a pickler with no buffer_callback keeps them in-band, so
+        # non-transport picklings (GCS persistence) still work.
+        inline = self.inline
+        if inline is not None:
+            if (protocol >= 5
+                    and len(inline) >= CONFIG.transport_oob_threshold_bytes):
+                inline = pickle.PickleBuffer(inline)
+            elif not isinstance(inline, bytes):
+                # normalize foreign buffer types: a meta re-forwarded
+                # after an out-of-band decode carries a memoryview,
+                # which plain pickle rejects
+                inline = bytes(inline)
         return (_mk_meta, ((self.object_id.binary(), self.size,
-                            self.inline, self.shm_name, self.error,
+                            inline, self.shm_name, self.error,
                             self.node_hint, self.arena_ref),))
 
     def is_error(self) -> bool:
@@ -189,6 +204,11 @@ class ObjectStore:
 
     # ------------------------------------------------------------------ put
     def put_inline(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
+        if not isinstance(data, bytes):
+            # a store-resident inline must own its bytes: a zero-copy
+            # view into a transport frame buffer would pin the whole
+            # (up to max-batch-sized) frame for the object's lifetime
+            data = bytes(data)
         meta = ObjectMeta(object_id=object_id, size=len(data), inline=data)
         with self._lock:
             self._ensure_capacity(len(data))
@@ -269,6 +289,27 @@ class ObjectStore:
             for oid, e in dead:
                 self._release_unsealed_locked(oid, e)
 
+    def abort_create(self, object_id: ObjectID) -> None:
+        """Discard an unsealed Create whose writer failed mid-fill: pop
+        the entry, uncharge the budget, and return its allocation (arena
+        block or owned shm segment). Without this a failed fill leaves a
+        permanently unsealed entry that ``reclaim_unsealed`` can never
+        match (no writer_tag) while its bytes stay charged forever."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or e.sealed:
+                return
+            self._release_unsealed_locked(object_id, e)
+            if e.segment is not None:
+                try:
+                    e.segment.close()
+                except (OSError, BufferError):
+                    pass        # an outstanding view keeps the mmap; the
+                try:            # unlink below still drops the backing file
+                    e.segment.unlink()
+                except OSError:
+                    pass
+
     def adopt(self, meta: ObjectMeta) -> bool:
         """Record an object whose segment was created by another process
         (a worker sealing a large task return). This is the main write path,
@@ -277,6 +318,12 @@ class ObjectStore:
         ``alloc_in_arena`` and budget is already charged. Returns False
         when a sealed copy already exists (the caller still owns its
         segment and must clean it up)."""
+        if meta.inline is not None and not isinstance(meta.inline, bytes):
+            # inline metas in the oob band (>= transport_oob_threshold,
+            # <= max_inline_object_bytes) decode as memoryviews into the
+            # recv frame buffer; a store-resident copy must not pin that
+            # whole frame (up to transport_max_batch_bytes) per object
+            meta.inline = bytes(meta.inline)
         with self._lock:
             existing = self._entries.get(meta.object_id)
             if existing is not None:
@@ -545,6 +592,58 @@ class ObjectStore:
             # is gone too (unlinked/reclaimed above) — redo the adoption
             # from the payload we still hold
             return self.adopt_payload(object_id, data)
+        return meta
+
+    def create_local(self, object_id: ObjectID, size: int
+                     ) -> Tuple[memoryview, ObjectMeta]:
+        """Writable destination for a SAME-PROCESS writer (the head
+        driver): an arena block when possible, else an owned segment.
+        The caller fills the view, then calls ``seal(object_id)`` —
+        no ALLOC/PUT round trips (reference analogue: the CoreWorker's
+        local plasma client)."""
+        ref = self.alloc_in_arena(object_id, size)
+        if ref is not None:
+            with self._lock:
+                meta = self._entries[object_id].meta
+            return self._arena.buffer(ref[1], size)[:size], meta
+        buf = self.create(object_id, size)
+        with self._lock:
+            meta = self._entries[object_id].meta
+        return buf, meta
+
+    def put_payload(self, object_id: ObjectID, data) -> ObjectMeta:
+        """Materialize wire bytes as the local PRIMARY copy, landing
+        them directly in an arena block when possible. ``data`` may be
+        a zero-copy memoryview into a transport frame buffer (pickle-5
+        out-of-band), so this is the payload's only copy after it left
+        the socket. Used for cross-host driver puts (PUT_OBJECT_WIRE)."""
+        size = len(data)
+        ref = self.alloc_in_arena(object_id, size)
+        if ref is not None:
+            self._arena.buffer(ref[1], size)[:] = data
+            meta = ObjectMeta(object_id=object_id, size=size,
+                              arena_ref=ref)
+            self.adopt(meta)            # the Seal half of Create/Seal
+            return meta
+        seg = create_segment(object_id, size)
+        try:
+            seg.buf[:size] = data
+            name = seg.name
+        finally:
+            seg.close()
+        meta = ObjectMeta(object_id=object_id, size=size, shm_name=name)
+        if not self.adopt(meta):
+            # a sealed copy already exists (duplicate put): ours is
+            # redundant and must not leak the segment
+            try:
+                s = shared_memory.SharedMemory(name=name)
+                s.close()
+                s.unlink()
+            except OSError:
+                pass
+            existing = self.get_meta(object_id)
+            if existing is not None:
+                return existing
         return meta
 
     def stats(self) -> Dict[str, int]:

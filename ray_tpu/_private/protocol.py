@@ -5,6 +5,12 @@ Equivalent role to the reference's gRPC surface (``protobuf/core_worker.proto``,
 length-prefixed pickled frames over unix-domain sockets — the control plane
 is local to a host; cross-host transfer rides the object plane (shm on one
 host, chunked TCP between hosts in the multi-node deployment).
+
+The transport batches and scatter-gathers (see ``Connection``): sends go
+through a per-connection bounded queue drained by a writer thread that
+coalesces every pending message into as few frames and ``sendmsg`` calls
+as possible, and large buffers ride out-of-band as iovecs (pickle
+protocol 5) instead of being copied through the pickle stream.
 """
 
 from __future__ import annotations
@@ -12,13 +18,17 @@ from __future__ import annotations
 import pickle
 import socket
 import struct
+import sys
 import threading
+import time
+import traceback
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import telemetry
+from .config import CONFIG
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
-
-_LEN = struct.Struct("<I")
 
 # ----------------------------------------------------------------- opcodes
 # client -> service
@@ -105,7 +115,8 @@ GEN_NEXT = 60           # (req_id, task_id, index) -> INFO_REPLY
 GEN_CLOSE = 61          # (task_id,) — consumer dropped the generator
 EXECUTE_BATCH = 62      # node -> worker: [EXECUTE_TASK payload, ...]
 # op 63 reserved (was TASK_DONE_BATCH; DONEs leave per task so an
-# early result is never withheld behind a slow batch successor)
+# early result is never withheld behind a slow batch successor —
+# transport-level write coalescing now batches them without withholding)
 CANCEL_QUEUED = 64      # node -> worker: task_id queued behind current
 RETURN_LEASED = 65      # worker -> node: [task_id] unstarted leased tasks
 RETURN_REFS = 66        # worker -> node: (return_oid, [contained oids]) —
@@ -126,6 +137,14 @@ STACK_DUMP = 69         # node -> worker/driver push: token
 STACK_REPLY = 70        # worker/driver -> node: (token, dump dict)
 PROFILE_START = 71      # node -> worker push: (token, opts dict)
 PROFILE_REPORT = 72     # worker -> node: (token, report dict)
+
+# Generic coalesced frame: (BATCH, [(op, payload), ...]). Produced by
+# the Connection writer when several messages are pending at flush time
+# — ONE pickle stream + one frame + one receiver wakeup for the burst —
+# and expanded transparently by the Connection decoder, so dispatch
+# code never sees it. Unlike SUBMIT_BATCH (a scheduler-level op with
+# one-dispatch-pass semantics) this is pure transport.
+BATCH = 73
 
 # service -> client
 EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
@@ -255,49 +274,634 @@ class PlacementGroupSpec:
 
 
 # --------------------------------------------------------------- connection
+#
+# Wire framing (v2):
+#
+#     frame := <u32 len> <u8 tag> <payload>       (len counts tag+payload)
+#
+#     tag 0 (plain): payload = pickle-5 stream, buffers in-band
+#     tag 1 (oob):   payload = <u32 pkl_len> <u32 nbuf> <u64 len_0..n-1>
+#                              <pickle stream> <buf_0> ... <buf_n-1>
+#
+# A tag-1 frame carries pickle protocol-5 out-of-band buffers: any
+# ``PickleBuffer`` (and any buffer-protocol object that opts into
+# protocol-5 out-of-band pickling, e.g. contiguous numpy arrays) of
+# ``transport_oob_threshold_bytes`` or more is shipped as a raw iovec
+# after the pickle stream instead of being copied into it. The decoder
+# hands ``pickle.loads`` zero-copy memoryviews into the frame buffer,
+# so a large payload is copied exactly once (socket -> frame buffer)
+# before landing at its destination (arena block / shm segment).
+
+_TAG_PLAIN = 0
+_TAG_OOB = 1
+_HDR = struct.Struct("<IB")          # frame length + tag
+_OOB_HDR = struct.Struct("<II")      # pickle_len, nbuf
+_U64 = struct.Struct("<Q")
+
+# frames at/above this size are received into a dedicated buffer filled
+# straight off the socket: one copy, and out-of-band views into it stay
+# valid without a second materialization
+_DEDICATED_RECV_MIN = 1 << 16
+# single-frame sends up to this body size concatenate header+body and
+# use one plain send: a sub-µs copy beats the extra-iovec sendmsg cost;
+# bigger bodies ride as iovecs (the copy the old transport paid on
+# EVERY frame is what this replaces)
+_SMALL_CONCAT_MAX = 1 << 12
+# iovecs per sendmsg call (IOV_MAX is 1024 on Linux; stay well under)
+_MAX_IOV = 512
+# close() flushes queued frames for at most this long before cutting
+# the socket (a wedged peer must not hang teardown)
+_CLOSE_DRAIN_TIMEOUT = 5.0
+
+
+def oob_wrap(data):
+    """Wrap a bytes-like payload so the transport ships it out-of-band
+    (zero-copy iovec) when it clears the threshold; small payloads stay
+    plain. The receiver sees a memoryview for wrapped payloads."""
+    if data is not None and len(data) >= CONFIG.transport_oob_threshold_bytes:
+        return pickle.PickleBuffer(data)
+    return data
+
+
+def fail_dropped_request(msg, exc: BaseException, lock, futures) -> None:
+    """Shared ``Connection.on_send_error`` body for request/reply
+    channels: when the transport drops a queued frame (encode failure
+    on the drainer path), fail the pending future whose req_id the
+    frame carried instead of letting its caller block forever.
+    Requests are ``(op, (req_id, ...))`` by construction at every
+    call site."""
+    try:
+        payload = msg[1]
+        req_id = payload[0] if type(payload) is tuple and payload else None
+    except Exception:
+        return
+    if not isinstance(req_id, int):
+        return
+    with lock:
+        fut = futures.pop(req_id, None)
+    if fut is not None and not fut.done():
+        fut.set_exception(
+            exc if isinstance(exc, Exception) else RuntimeError(str(exc)))
+
+
+def _est_size(payload, depth: int = 3) -> int:
+    """Cheap pre-pickle size estimate used to bound batch frames. Exact
+    for the dominant large carriers (bytes-like leaves, PickleBuffers
+    and ObjectMeta inlines); everything else counts a small constant.
+    Depth 3 reaches the hottest shapes' payloads — a TASK_DONE /
+    GET_REPLY message is ``(op, (id, [metas], ...))``, putting the
+    metas three levels down. Long lists are sampled (first 16) and
+    extrapolated so a burst of meta-carrying replies still respects
+    ``transport_max_batch_bytes``."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return len(payload) + 32
+    if isinstance(payload, pickle.PickleBuffer):
+        try:
+            return payload.raw().nbytes + 32
+        except Exception:
+            return 64
+    if depth > 0 and isinstance(payload, (tuple, list)):
+        n = len(payload)
+        if n == 0:
+            return 32
+        est = sum(_est_size(v, depth - 1) for v in payload[:16])
+        if n > 16:
+            est = est * n // 16
+        return 32 + est
+    inline = getattr(payload, "inline", None)
+    if inline is not None:
+        return len(inline) + 128
+    return 64
+
 
 class Connection:
-    """Blocking framed-message socket with thread-safe sends."""
+    """Framed-message socket: batched, vectored, thread-safe sends
+    through a per-connection writer thread; burst receives.
+
+    ``send`` enqueues and returns; a lazily-started writer drains the
+    queue the moment it is non-empty (opportunistic corking — no
+    latency timers), packing every pending message into as few frames
+    (small ones coalesce into one ``BATCH``) and as few ``sendmsg``
+    syscalls as possible. The receive side decodes every complete frame
+    per socket wakeup, so ``recv_many`` hands the dispatcher a whole
+    burst at once.
+    """
 
     def __init__(self, sock: socket.socket):
         self._sock = sock
-        self._send_lock = threading.Lock()
+        self._sendmsg = getattr(sock, "sendmsg", None)
+        self._qlock = threading.Lock()      # guards _outq + flags
+        self._flush_lock = threading.Lock() # held by the active drainer
+        self._outq: "deque" = deque()
+        self._broken = False            # socket died under a drainer
+        self._closing = False
         self._recv_buf = bytearray()
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 21)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 21)
+        self._decoded: "deque" = deque()
+        self._max_batch_msgs = max(1, CONFIG.transport_max_batch_msgs)
+        self._max_batch_bytes = max(1 << 12, CONFIG.transport_max_batch_bytes)
+        self._queue_depth = max(1, CONFIG.transport_queue_depth)
+        self._oob_threshold = max(1, CONFIG.transport_oob_threshold_bytes)
+        # flush stats, accumulated as plain ints on the (single-drainer)
+        # flush path and published to telemetry every 64 flushes — the
+        # single-message fast path must not pay shard locks per frame
+        self._stat_flushes = 0
+        self._stat_msgs = 0
+        self._stat_bytes = 0
+        self._stat_oob = 0
+        # out-of-band views collected by _buffer_cb during one encode;
+        # safe as instance state because encoding only happens under
+        # _flush_lock (single drainer)
+        self._oob_scratch: List[memoryview] = []
+        # called with (msg, exc) when a queued message is dropped on the
+        # drainer path (encode failure that cannot be raised to its
+        # sender) — request/reply channels hook this to fail the pending
+        # future the dropped request would otherwise hang forever
+        self.on_send_error = None
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                            CONFIG.socket_send_buffer_bytes)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                            CONFIG.socket_recv_buffer_bytes)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- sending
+    #
+    # Combining drainer ("the writer"): the sending thread enqueues and
+    # then tries to become the drainer. Uncontended sends go straight to
+    # the socket with no handoff; when another thread is already mid-
+    # ``sendmsg``, messages pile onto the queue and the active drainer
+    # picks them ALL up in one coalesced batch before releasing — the
+    # burst pays one pickle header + one syscall. Opportunistic corking
+    # with zero added latency (no timers, no thread hop).
 
     def send(self, msg: Tuple[int, Any]) -> None:
-        data = pickle.dumps(msg, protocol=5)
-        frame = _LEN.pack(len(data)) + data
-        with self._send_lock:
-            self._sock.sendall(frame)
-
-    def recv(self) -> Optional[Tuple[int, Any]]:
-        """Blocking receive of one message; None on clean EOF."""
-        header = self._recv_exact(_LEN.size)
-        if header is None:
-            return None
-        (length,) = _LEN.unpack(header)
-        body = self._recv_exact(length)
-        if body is None:
-            return None
-        return pickle.loads(body)
-
-    def _recv_exact(self, n: int) -> Optional[bytes]:
-        buf = self._recv_buf
-        while len(buf) < n:
+        """Send one message. Uncontended sends (no active drainer,
+        empty queue — the overwhelmingly common case) encode and write
+        inline with zero queue/wakeup bookkeeping, so batching costs
+        nothing when there is nothing to batch."""
+        if not self._outq and self._flush_lock.acquire(blocking=False):
             try:
-                chunk = self._sock.recv(max(n - len(buf), 1 << 16))
-            except (ConnectionResetError, OSError):
-                return None
-            if not chunk:
-                return None
-            buf.extend(chunk)
-        out = bytes(buf[:n])
-        del buf[:n]
+                # benign unlocked read: the flags only ever flip to
+                # True, and a send that slips past lands on a dead
+                # socket and raises from sendmsg anyway
+                if self._broken or self._closing:
+                    raise OSError("connection is closed")
+                try:
+                    self._send_one(msg, reraise=True)
+                except (OSError, ValueError):
+                    self._poison()
+                    raise
+                finally:
+                    # strand-guard: a contended producer that saw our
+                    # lock held returned expecting us to pick its
+                    # messages up — runs even when OUR encode failed
+                    # (the connection is healthy then; a poisoned
+                    # socket cleared the queue already)
+                    if not self._broken:
+                        self._drain_holding()
+            finally:
+                self._flush_lock.release()
+                if self._outq:
+                    # an enqueue slipped in after our final check but
+                    # before the release — drain it like any other
+                    # producer
+                    self._drain()
+            return
+        self._enqueue((msg,))
+        self._drain()
+
+    def send_many(self, msgs) -> None:
+        """Queue several messages as one ordered burst, then flush."""
+        if msgs:
+            self._enqueue(tuple(msgs))
+            self._drain()
+
+    def _enqueue(self, msgs: tuple) -> None:
+        with self._qlock:
+            if self._broken or self._closing:
+                raise OSError("connection is closed")
+            self._outq.extend(msgs)
+            over = len(self._outq) > self._queue_depth
+        if over:
+            # bounded queue: the producer becomes/waits-for the drainer
+            # until the backlog is gone (a streamed multi-GB pull must
+            # not buffer unbounded frames in memory)
+            telemetry.counter_inc(telemetry.M_TRANSPORT_QUEUE_STALLS)
+            self._drain(block=True)
+
+    def _poison(self) -> None:
+        """Socket died under a drainer: drop the backlog and poison
+        future sends; the peer-death signal is the reader's EOF."""
+        with self._qlock:
+            self._broken = True
+            self._outq.clear()
+
+    def _drain_holding(self) -> None:
+        """Drain the queue to empty. Caller holds ``_flush_lock``."""
+        while self._outq:       # unlocked peek: misses are caught by
+            with self._qlock:   # the post-release re-check in send()
+                batch = list(self._outq)
+                self._outq.clear()
+                if not batch:
+                    return
+            try:
+                self._write_batch(batch)
+            except (OSError, ValueError):
+                self._poison()
+                raise
+
+    def _drain(self, block: bool = False) -> None:
+        while True:
+            if not self._outq:
+                return
+            if not self._flush_lock.acquire(blocking=block):
+                # an active drainer exists; it re-checks the queue after
+                # releasing, so our messages cannot be stranded — but if
+                # they are still queued once it released, loop and drain
+                # them ourselves (covers the enqueue-after-final-check
+                # race)
+                if not block:
+                    with self._qlock:
+                        if self._outq and not self._flush_lock.locked():
+                            continue
+                    return
+                continue
+            try:
+                self._drain_holding()
+            finally:
+                self._flush_lock.release()
+
+    def flush(self, timeout: Optional[float] = 5.0) -> None:
+        """Block until every message enqueued before this call reached
+        the socket (or the connection died / the timeout expired).
+
+        The delivery guarantee is ACQUIRING ``_flush_lock``, not
+        observing an empty queue: a batch another drainer popped before
+        this call only counts as written once that drainer releases —
+        an empty ``_outq`` alone says nothing about frames mid-
+        ``sendmsg`` in a foreign drainer."""
+        deadline = (time.monotonic() + timeout) if timeout else None
+        while not self._broken:
+            remaining = 0.1
+            if deadline is not None:
+                remaining = min(0.1, deadline - time.monotonic())
+                if remaining <= 0:
+                    return
+            if self._flush_lock.acquire(timeout=remaining):
+                try:
+                    try:
+                        self._drain_holding()
+                    except OSError:
+                        return
+                finally:
+                    self._flush_lock.release()
+                if not self._outq:
+                    return
+
+    def _send_one(self, msg, reraise: bool = False) -> None:
+        """Single-message fast path: encode + vectored write with
+        minimal bookkeeping (no chunk list, no grouping pass).
+
+        ``reraise`` propagates encode failures to the caller — the
+        uncontended ``send()`` path, where the sender is the thread
+        that owns the message and a dropped frame would leave a
+        request-reply future unresolved forever. The drainer/batch
+        path keeps drop-with-traceback: there the encoding thread may
+        not be the sender, and one bad payload must not poison the
+        connection."""
+        try:
+            body = pickle.dumps(msg, protocol=5,
+                                buffer_callback=self._buffer_cb)
+        except Exception as exc:
+            self._oob_scratch.clear()
+            if reraise:
+                raise
+            self._drop_msg(msg, exc)
+            return
+        if self._oob_scratch:
+            chunks: list = []
+            oob = self._oob_frame(body, chunks)
+            self._account(1, chunks, oob)
+            self._sendv(chunks)
+            return
+        nbody = len(body)
+        total = _HDR.size + nbody
+        self._stat_flushes += 1
+        self._stat_msgs += 1
+        self._stat_bytes += total
+        if self._stat_flushes >= 64:
+            self._publish_stats()
+        hdr = _HDR.pack(1 + nbody, _TAG_PLAIN)
+        sendmsg = self._sendmsg
+        if nbody <= _SMALL_CONCAT_MAX or sendmsg is None:
+            self._sock.sendall(hdr + body)
+            return
+        sent = sendmsg((hdr, body))
+        if sent < total:
+            self._finish_partial([hdr, body], sent, total, sendmsg)
+
+    def _drop_msg(self, msg, exc: BaseException) -> None:
+        """A queued message failed to encode on the drainer path and
+        cannot be raised to its sender (the drainer may be a different
+        thread): log it, and give the owning channel a chance to fail
+        the pending future a dropped request would otherwise hang."""
+        traceback.print_exc(file=sys.stderr)
+        cb = self.on_send_error
+        if cb is not None:
+            try:
+                cb(msg, exc)
+            except Exception:
+                pass
+
+    def _write_batch(self, batch: list) -> None:
+        if len(batch) == 1:
+            self._send_one(batch[0])
+            return
+        chunks = []
+        oob_bytes = 0
+        group: list = []
+        group_est = 0
+
+        def emit_group():
+            nonlocal group, group_est, oob_bytes
+            if not group:
+                return
+            msg = group[0] if len(group) == 1 else (BATCH, group)
+            try:
+                oob_bytes += self._encode_frame(msg, chunks)
+            except Exception as exc:
+                # one unpicklable payload must not poison its batchmates
+                # (or the connection): retry one by one, dropping the
+                # offender with a traceback + on_send_error
+                if len(group) > 1:
+                    for one in group:
+                        try:
+                            oob_bytes += self._encode_frame(one, chunks)
+                        except Exception as one_exc:
+                            self._drop_msg(one, one_exc)
+                else:
+                    self._drop_msg(group[0], exc)
+            group = []
+            group_est = 0
+
+        for msg in batch:
+            est = _est_size(msg)
+            if group and (len(group) >= self._max_batch_msgs
+                          or group_est + est > self._max_batch_bytes):
+                emit_group()
+            group.append(msg)
+            group_est += est
+        emit_group()
+        if not chunks:
+            return
+        # a coalesced flush is the interesting signal: record it exactly
+        telemetry.hist_observe(telemetry.M_TRANSPORT_FLUSH_FRAMES,
+                               float(len(batch)))
+        self._account(len(batch), chunks, oob_bytes)
+        self._sendv(chunks)
+
+    def _account(self, n_msgs: int, chunks: list, oob_bytes: int) -> None:
+        """Accumulate flush stats as plain ints (we are the only
+        drainer); publish to telemetry every 64 flushes."""
+        self._stat_flushes += 1
+        self._stat_msgs += n_msgs
+        self._stat_bytes += sum(len(c) for c in chunks)
+        self._stat_oob += oob_bytes
+        if self._stat_flushes >= 64:
+            self._publish_stats()
+
+    def _publish_stats(self) -> None:
+        flushes, msgs = self._stat_flushes, self._stat_msgs
+        nbytes, oob = self._stat_bytes, self._stat_oob
+        self._stat_flushes = self._stat_msgs = 0
+        self._stat_bytes = self._stat_oob = 0
+        telemetry.counter_inc(telemetry.M_TRANSPORT_SEND_BYTES,
+                              float(nbytes))
+        if oob:
+            telemetry.counter_inc(telemetry.M_TRANSPORT_OOB_BYTES,
+                                  float(oob))
+        if msgs == flushes:
+            # all-singles window: one aggregate observation keeps the
+            # frames-per-flush histogram honest about uncoalesced load
+            # without paying a shard lock per frame
+            telemetry.hist_observe(telemetry.M_TRANSPORT_FLUSH_FRAMES, 1.0)
+
+    def _buffer_cb(self, pb) -> bool:
+        """pickle-5 buffer_callback: large contiguous buffers collect
+        into _oob_scratch to ride out-of-band (bound method — no
+        closure allocation per frame)."""
+        try:
+            view = pb.raw()
+        except Exception:               # non-contiguous: in-band copy
+            return True
+        if view.nbytes < self._oob_threshold:
+            return True                 # truthy => keep in-band
+        self._oob_scratch.append(view)
+        return False                    # falsy => ship out-of-band
+
+    def _encode_frame(self, msg, chunks: list) -> int:
+        """Append one frame's iovec chunks; returns out-of-band bytes."""
+        try:
+            body = pickle.dumps(msg, protocol=5,
+                                buffer_callback=self._buffer_cb)
+        except Exception:
+            self._oob_scratch.clear()
+            raise
+        if not self._oob_scratch:
+            chunks.append(_HDR.pack(1 + len(body), _TAG_PLAIN))
+            chunks.append(body)
+            return 0
+        return self._oob_frame(body, chunks)
+
+    def _oob_frame(self, body: bytes, chunks: list) -> int:
+        """Append a tag-1 frame carrying _oob_scratch as iovecs."""
+        buffers = list(self._oob_scratch)
+        self._oob_scratch.clear()
+        oob = 0
+        lens = bytearray()
+        for v in buffers:
+            lens += _U64.pack(v.nbytes)
+            oob += v.nbytes
+        total = 1 + _OOB_HDR.size + len(lens) + len(body) + oob
+        chunks.append(_HDR.pack(total, _TAG_OOB)
+                      + _OOB_HDR.pack(len(body), len(buffers)) + lens)
+        chunks.append(body)
+        chunks.extend(buffers)
+        return oob
+
+    def _sendv(self, chunks: list) -> None:
+        """Vectored send of every chunk, handling partial writes."""
+        sendmsg = self._sendmsg
+        if sendmsg is None:             # pragma: no cover - non-Linux
+            for c in chunks:
+                self._sock.sendall(c)
+            return
+        i = 0
+        n = len(chunks)
+        while i < n:
+            if i == 0 and n <= _MAX_IOV:
+                group = chunks          # common case: no slice copy
+            else:
+                group = chunks[i:i + _MAX_IOV]
+            i += len(group)
+            total = sum(len(c) for c in group)
+            sent = sendmsg(group)
+            if sent < total:
+                self._finish_partial(list(group), sent, total, sendmsg)
+
+    @staticmethod
+    def _finish_partial(group: list, sent: int, total: int,
+                        sendmsg) -> None:
+        """Resend the unsent tail after a short ``sendmsg`` (kernel
+        buffer filled mid-frame)."""
+        while sent < total:
+            total -= sent
+            j = 0
+            while sent >= len(group[j]):
+                sent -= len(group[j])
+                j += 1
+            if sent:
+                group = [memoryview(group[j])[sent:]] + group[j + 1:]
+            else:
+                group = group[j:]
+            sent = sendmsg(group)
+
+    # ----------------------------------------------------------- receiving
+    def recv(self) -> Optional[Tuple[int, Any]]:
+        """Blocking receive of one message; None on EOF."""
+        if not self._decoded and not self._fill_decoded():
+            return None
+        return self._decoded.popleft()
+
+    def recv_many(self) -> Optional[List[Tuple[int, Any]]]:
+        """Blocking receive of every already-decodable message (>= 1);
+        None on EOF. One socket wakeup hands the caller a whole burst."""
+        if not self._decoded and not self._fill_decoded():
+            return None
+        out = list(self._decoded)
+        self._decoded.clear()
+        if len(out) > 1:
+            telemetry.hist_observe(telemetry.M_TRANSPORT_RECV_FRAMES,
+                                   float(len(out)))
         return out
 
+    def _fill_decoded(self) -> bool:
+        """Read + decode until at least one message is ready. All frames
+        already buffered decode in one pass (multi-frame decoder)."""
+        out = self._decoded
+        rb = self._recv_buf
+        while not out:
+            # decode every complete frame in the shared buffer; compact
+            # once per pass instead of copying per read
+            pos = 0
+            end = len(rb)
+            if end >= _HDR.size:
+                mv = memoryview(rb)
+                try:
+                    while end - pos >= _HDR.size:
+                        length, tag = _HDR.unpack_from(rb, pos)
+                        if length < 1:
+                            return False        # corrupt stream
+                        if end - pos - _HDR.size < length - 1:
+                            break
+                        body = mv[pos + _HDR.size:
+                                  pos + _HDR.size + length - 1]
+                        try:
+                            self._decode_body(tag, body, out, owned=False)
+                        finally:
+                            body.release()
+                        pos += _HDR.size + length - 1
+                finally:
+                    mv.release()
+                if pos:
+                    del rb[:pos]
+                    if out:
+                        return True
+            # a large incomplete frame is read straight into a dedicated
+            # buffer: one copy off the socket, and out-of-band views
+            # into it stay valid with no re-materialization
+            if len(rb) >= _HDR.size:
+                length, tag = _HDR.unpack_from(rb, 0)
+                if length - 1 >= _DEDICATED_RECV_MIN:
+                    body = bytearray(length - 1)
+                    have = len(rb) - _HDR.size
+                    body[:have] = memoryview(rb)[_HDR.size:]
+                    del rb[:]
+                    if not self._recv_into(memoryview(body)[have:]):
+                        return False
+                    self._decode_body(tag, memoryview(body), out,
+                                      owned=True)
+                    continue
+            try:
+                # modest read size: CPython allocates the full bufsize
+                # per recv() call, so a large constant here pays an
+                # allocation + page-fault tax on every wakeup
+                chunk = self._sock.recv(1 << 16)
+            except (ConnectionResetError, OSError):
+                return False
+            if not chunk:
+                return False
+            rb += chunk
+        return True
+
+    def _recv_into(self, view: memoryview) -> bool:
+        while view.nbytes:
+            try:
+                n = self._sock.recv_into(view)
+            except (ConnectionResetError, OSError):
+                return False
+            if not n:
+                return False
+            view = view[n:]
+        return True
+
+    def _decode_body(self, tag: int, body: memoryview, out: deque,
+                     owned: bool) -> None:
+        if tag == _TAG_PLAIN:
+            msg = pickle.loads(body)
+        else:
+            if not owned:
+                # out-of-band views must outlive the shared recv buffer
+                body = memoryview(bytearray(body))
+            pkl_len, nbuf = _OOB_HDR.unpack_from(body, 0)
+            off = _OOB_HDR.size + nbuf * _U64.size
+            pkl = body[off:off + pkl_len]
+            off += pkl_len
+            bufs = []
+            for i in range(nbuf):
+                (blen,) = _U64.unpack_from(body,
+                                           _OOB_HDR.size + i * _U64.size)
+                bufs.append(body[off:off + blen])
+                off += blen
+            msg = pickle.loads(pkl, buffers=bufs)
+        if type(msg) is tuple and msg and msg[0] == BATCH:
+            out.extend(msg[1])
+        else:
+            out.append(msg)
+
+    # ------------------------------------------------------------ lifecycle
     def close(self) -> None:
+        with self._qlock:
+            self._closing = True
+        # drain what was queued before the close (a side-effecting frame
+        # sent just before shutdown must still reach the peer) — but
+        # bounded: a wedged peer that stopped reading leaves the socket
+        # buffer full, and teardown must not hang on it. The shutdown()
+        # below also errors out a foreign drainer stuck mid-send, which
+        # is what unblocked a stuck sendall in the pre-batching
+        # transport.
+        try:
+            self._sock.settimeout(_CLOSE_DRAIN_TIMEOUT)
+        except OSError:
+            pass
+        if self._flush_lock.acquire(timeout=_CLOSE_DRAIN_TIMEOUT):
+            try:
+                self._drain_holding()
+            except OSError:
+                pass
+            finally:
+                self._flush_lock.release()
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

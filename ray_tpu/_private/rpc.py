@@ -35,6 +35,7 @@ class RpcChannel:
         self._lock = threading.Lock()
         self._next_req = 1
         self._closed = threading.Event()
+        conn.on_send_error = self._on_send_error
         self._thread = threading.Thread(target=self._read_loop,
                                         name="rtpu-rpc-reader", daemon=True)
         self._thread.start()
@@ -45,8 +46,11 @@ class RpcChannel:
 
     def _read_loop(self) -> None:
         while True:
-            msg = self._conn.recv()
-            if msg is None:
+            # burst receive: one socket wakeup dispatches every frame
+            # the peer's writer coalesced (replies resolve their futures
+            # back-to-back instead of one wakeup each)
+            msgs = self._conn.recv_many()
+            if msgs is None:
                 self._fail_all(ConnectionError("rpc channel closed"))
                 if self._on_close is not None:
                     try:
@@ -54,25 +58,32 @@ class RpcChannel:
                     except Exception:
                         pass
                 return
-            op, payload = msg
-            if op in self._reply_ops:
-                req_id, value = payload
-                with self._lock:
-                    fut = self._futures.pop(req_id, None)
-                if fut is not None:
-                    fut.set_result(value)
-            elif op == P.ERROR_REPLY:
-                req_id, err = payload
-                with self._lock:
-                    fut = self._futures.pop(req_id, None)
-                if fut is not None:
-                    from . import serialization as ser
-                    fut.set_exception(ser.from_bytes(err))
-            elif self._on_push is not None:
-                try:
-                    self._on_push(op, payload)
-                except Exception:
-                    pass
+            for msg in msgs:
+                self._dispatch_one(msg)
+
+    def _dispatch_one(self, msg: Tuple[int, Any]) -> None:
+        op, payload = msg
+        if op in self._reply_ops:
+            req_id, value = payload
+            with self._lock:
+                fut = self._futures.pop(req_id, None)
+            if fut is not None:
+                fut.set_result(value)
+        elif op == P.ERROR_REPLY:
+            req_id, err = payload
+            with self._lock:
+                fut = self._futures.pop(req_id, None)
+            if fut is not None:
+                from . import serialization as ser
+                fut.set_exception(ser.from_bytes(err))
+        elif self._on_push is not None:
+            try:
+                self._on_push(op, payload)
+            except Exception:
+                pass
+
+    def _on_send_error(self, msg, exc: BaseException) -> None:
+        P.fail_dropped_request(msg, exc, self._lock, self._futures)
 
     def _fail_all(self, exc: Exception) -> None:
         with self._lock:

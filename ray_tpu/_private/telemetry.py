@@ -432,6 +432,26 @@ M_DROPPED_SERIES = define(
     "Metric series dropped by the control plane (cardinality cap or "
     "histogram bucket conflicts); synthesized at export from the "
     "plane's drop counter")
+# wire transport (``protocol.Connection``): recorded per writer flush /
+# receive wakeup, never per message — the hot path stays lock-cheap
+_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+M_TRANSPORT_FLUSH_FRAMES = define(
+    "histogram", "rtpu_transport_flush_frames",
+    "Messages coalesced per connection-writer flush",
+    buckets=_BATCH_BUCKETS)
+M_TRANSPORT_RECV_FRAMES = define(
+    "histogram", "rtpu_transport_recv_frames",
+    "Messages decoded per receive wakeup (burst dispatch)",
+    buckets=_BATCH_BUCKETS)
+M_TRANSPORT_SEND_BYTES = define(
+    "counter", "rtpu_transport_send_bytes_total",
+    "Bytes written to control-plane sockets (frames incl. headers)")
+M_TRANSPORT_OOB_BYTES = define(
+    "counter", "rtpu_transport_oob_bytes_total",
+    "Payload bytes shipped out-of-band as zero-copy iovecs")
+M_TRANSPORT_QUEUE_STALLS = define(
+    "counter", "rtpu_transport_queue_stalls_total",
+    "Producer blocks on a full connection send queue (backpressure)")
 
 
 def attach_node(node) -> None:

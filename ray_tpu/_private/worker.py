@@ -72,27 +72,33 @@ class WorkerRuntime:
                                      self.worker_id.binary(), os.getpid())))
         self._exec_thread.start()
         while True:
-            msg = self.conn.recv()
-            if msg is None:
+            # burst receive: leases the node's writer coalesced enqueue
+            # in one wakeup (the exec thread drains them back-to-back)
+            msgs = self.conn.recv_many()
+            if msgs is None:
                 os._exit(0)
-            op, payload = msg
-            if op == P.EXECUTE_TASK:
-                if not self._maybe_bounce(payload):
-                    self._enqueue_execute(payload)
-            elif op == P.EXECUTE_BATCH:
-                # the batch frame amortizes the node->worker side; each
-                # task's DONE still leaves individually (withholding an
-                # early result until a batch's last task finished would
-                # stall callers behind an arbitrarily long successor)
-                for item in payload:
-                    if not self._maybe_bounce(item):
-                        self._enqueue_execute(item)
-            elif op == P.CANCEL_QUEUED:
-                self._cancelled_queued.add(payload)
-            elif op == P.SHUTDOWN:
-                os._exit(0)
-            else:
-                self.client.handle_message(op, payload)
+            for op, payload in msgs:
+                if op == P.EXECUTE_TASK:
+                    if not self._maybe_bounce(payload):
+                        self._enqueue_execute(payload)
+                elif op == P.EXECUTE_BATCH:
+                    # the batch frame amortizes the node->worker side;
+                    # each task's DONE still leaves per task (withholding
+                    # an early result until a batch's last task finished
+                    # would stall callers behind a slow successor) —
+                    # transport write-coalescing batches the frames
+                    for item in payload:
+                        if not self._maybe_bounce(item):
+                            self._enqueue_execute(item)
+                elif op == P.CANCEL_QUEUED:
+                    self._cancelled_queued.add(payload)
+                elif op == P.SHUTDOWN:
+                    # drain queued outbound frames (a TASK_DONE may still
+                    # sit in the writer queue) before dying
+                    self.conn.close()
+                    os._exit(0)
+                else:
+                    self.client.handle_message(op, payload)
 
     def _maybe_bounce(self, payload) -> bool:
         """Reader-side: a plain-task lease arriving while the exec

@@ -70,6 +70,47 @@ def readme_metric_names(readme_path: str) -> Set[str]:
         return set()
 
 
+_REGISTRY_ROW_RE = re.compile(
+    r"^\|\s*`(rtpu_[a-z0-9_]+)`\s*\|\s*(\w+)\s*\|", re.MULTILINE)
+
+
+def readme_registry_types(readme_path: str) -> Dict[str, str]:
+    """Metric name -> declared type (counter/gauge/histogram) from the
+    README's "Runtime metric registry" table rows. Empty when the
+    README has no such table (the name-presence check still applies)."""
+    try:
+        with open(readme_path) as f:
+            text = f.read()
+    except OSError:
+        return {}
+    return dict(_REGISTRY_ROW_RE.findall(text))
+
+
+def collect_defined_metric_kinds(pkg_dir: str,
+                                 files=None) -> Dict[str, Tuple[str, str]]:
+    """Metric name -> (kind, file) for every ``telemetry.define(kind,
+    name, ...)`` with literal kind and name."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for rel, tree in (files if files is not None
+                      else _walk_files(pkg_dir)):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name != "define" or len(node.args) < 2:
+                continue
+            kind_arg, name_arg = node.args[0], node.args[1]
+            if (isinstance(kind_arg, ast.Constant)
+                    and isinstance(kind_arg.value, str)
+                    and isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)
+                    and name_arg.value.startswith("rtpu_")):
+                out[name_arg.value] = (kind_arg.value, rel)
+    return out
+
+
 def _walk_files(pkg_dir: str):
     for dirpath, _dirs, files in os.walk(pkg_dir):
         for fname in files:
@@ -180,6 +221,17 @@ def check(repo_root: str = None) -> List[str]:
         problems.append(
             f"{name}: listed in the README registry but no "
             "telemetry.define() in ray_tpu/ registers it")
+    # type column of the registry table must match the define() kind
+    # (a histogram documented as a counter misleads every dashboard)
+    kinds = collect_defined_metric_kinds(os.path.join(root, "ray_tpu"),
+                                         files)
+    row_types = readme_registry_types(os.path.join(root, "README.md"))
+    for name, (kind, where) in sorted(kinds.items()):
+        doc_type = row_types.get(name)
+        if doc_type is not None and doc_type != kind:
+            problems.append(
+                f"{name} ({where}): defined as {kind} but the README "
+                f"registry row says {doc_type}")
     problems += check_events(root, files)
     return problems
 
