@@ -238,6 +238,13 @@ class CoreClient:
                     ev[0] = consumed
                 if ev is not None:
                     ev[1].set()
+        elif op == P.COLL_DELIVER:
+            # collective chunk for a rank in this process: deposit on
+            # THIS (reader) thread — the rank thread blocked in
+            # coll_transport.wait() wakes under the condition variable
+            from . import coll_transport
+            coll_key, data = payload
+            coll_transport.deposit(tuple(coll_key), data)
         elif op == P.STACK_DUMP:
             # answered from THIS (reader) thread on purpose: it is never
             # the one blocked in user code, so a process wedged in get()

@@ -1,0 +1,150 @@
+"""Peer-to-peer collective chunk plane: per-process mailbox + routed sends.
+
+The data plane under ``comm/collective.py``'s ring/tree schedules. A rank
+is addressed by its **endpoint** ``(node_id_bytes, worker_id_bytes)``;
+``send`` ships one ``COLL_ROUTE`` frame to this process's node, which
+delivers it to the destination process's connection — directly when the
+destination lives on the same node, across the node plane (``COLL_FWD``)
+otherwise. Tensor payloads are numpy arrays, which ride each hop
+out-of-band (pickle protocol-5 iovecs) once they clear
+``transport_oob_threshold_bytes`` — zero-copy end to end.
+
+Completion is driven by connection reader threads: an arriving
+``COLL_DELIVER`` frame is deposited here (``deposit``) under a condition
+variable that wakes the rank thread blocked in ``wait``. There is no
+polling anywhere on this path — a waiter sleeps until its chunk arrives
+or its deadline passes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from . import context
+from . import telemetry
+from .config import CONFIG
+
+M_COLL_CHUNKS = telemetry.define(
+    "counter", "rtpu_collective_chunks_total",
+    "Peer-to-peer collective chunks sent by this rank")
+M_COLL_WIRE_BYTES = telemetry.define(
+    "counter", "rtpu_collective_wire_bytes_total",
+    "Payload bytes this rank sent peer-to-peer for collectives (ring "
+    "allreduce: ~2x tensor size per rank, independent of world size)")
+M_COLL_INFLIGHT = telemetry.define(
+    "gauge", "rtpu_collective_inflight_chunks",
+    "Collective chunks delivered to this process but not yet consumed "
+    "by a waiting rank thread")
+
+_lock = threading.Lock()
+_cond = threading.Condition(_lock)
+_slots: Dict[tuple, Any] = {}
+# arrival time per undelivered chunk, for the stale sweep: a rank that
+# timed out (or died) mid-collective leaves chunks addressed to keys no
+# waiter will ever consume — without a TTL they'd sit here until
+# destroy_collective_group, growing without bound across retried calls
+_born: Dict[tuple, float] = {}
+_next_sweep = [0.0]             # guarded by _lock
+
+# plain per-process counters for tests/diagnostics (no shard-lock cost);
+# single-writer per field in practice (the rank thread / reader thread)
+_stats = {"sent_chunks": 0, "sent_bytes": 0, "recv_chunks": 0,
+          "recv_bytes": 0}
+
+
+def local_endpoint() -> Optional[Tuple[bytes, bytes]]:
+    """This process's rank address, or None when no runtime client is
+    connected (the group then degrades to the coordinator fallback)."""
+    client = context.current_client
+    if client is None or client.node_id is None:
+        return None
+    return (client.node_id.binary(), client.worker_id.binary())
+
+
+def send(dest: Tuple[bytes, bytes], key: tuple, payload,
+         group: str = "", op: str = "") -> None:
+    """Route one chunk to ``dest``'s mailbox under ``key``. Fire and
+    forget: delivery failures surface as the receiver's deadline."""
+    from . import protocol as P
+    client = context.require_client()
+    nbytes = int(getattr(payload, "nbytes", 0) or 0)
+    client.conn.send((P.COLL_ROUTE, (dest[0], dest[1], key, payload)))
+    _stats["sent_chunks"] += 1
+    _stats["sent_bytes"] += nbytes
+    tags = (("group", group), ("op", op))
+    telemetry.counter_inc(M_COLL_CHUNKS, 1.0, tags)
+    if nbytes:
+        telemetry.counter_inc(M_COLL_WIRE_BYTES, float(nbytes), tags)
+
+
+def deposit(key: tuple, value) -> None:
+    """Reader-thread side: park an arrived chunk and wake waiters."""
+    now = time.monotonic()
+    with _cond:
+        _slots[key] = value
+        _born[key] = now
+        if now >= _next_sweep[0]:
+            ttl = CONFIG.collective_call_ttl_s
+            _next_sweep[0] = now + max(1.0, ttl / 4)
+            for k in [k for k, b in _born.items() if now - b > ttl]:
+                _slots.pop(k, None)
+                _born.pop(k, None)
+        n = len(_slots)
+        _cond.notify_all()
+    _stats["recv_chunks"] += 1
+    _stats["recv_bytes"] += int(getattr(value, "nbytes", 0) or 0)
+    telemetry.gauge_set(M_COLL_INFLIGHT, float(n))
+
+
+def wait(key: tuple, deadline: float, what: str = "collective chunk"):
+    """Block until ``key``'s chunk arrives; raises TimeoutError at the
+    deadline (a dead peer must not hang the survivors)."""
+    with _cond:
+        while key not in _slots:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"timed out waiting for {what} {key!r} — a group "
+                    "member is dead, wedged, or running a mismatched "
+                    "collective schedule")
+            _cond.wait(remaining)
+        value = _slots.pop(key)
+        _born.pop(key, None)
+        n = len(_slots)
+    telemetry.gauge_set(M_COLL_INFLIGHT, float(n))
+    return value
+
+
+def flush() -> None:
+    """Block until every chunk this process queued on its node link has
+    reached the socket. Schedules send ZERO-COPY views of caller-owned
+    (and returned) arrays; under send-queue contention those views are
+    pickled later by whichever thread drains the queue, so a collective
+    only becomes safe to return from — letting the caller mutate its
+    tensors — once the link is flushed. Uncontended (the common case)
+    this is one try-lock."""
+    client = context.current_client
+    if client is not None:
+        client.conn.flush()
+
+
+def drop_group(group: str, epoch: str) -> None:
+    """Discard undelivered chunks of a destroyed group (keys lead with
+    (group, epoch)) so name reuse can never consume stale traffic."""
+    with _cond:
+        for k in [k for k in _slots
+                  if k[:2] == (group, epoch)]:
+            del _slots[k]
+            _born.pop(k, None)
+        telemetry.gauge_set(M_COLL_INFLIGHT, float(len(_slots)))
+
+
+def stats() -> Dict[str, int]:
+    """Per-process wire counters (tests assert ring traffic is O(size)
+    per rank, not O(world * size) through one process)."""
+    out = dict(_stats)
+    with _lock:
+        out["pending"] = len(_slots)
+    return out

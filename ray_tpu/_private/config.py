@@ -185,6 +185,31 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                       "instead of inside the pickle "
                                       "stream"),
     "rpc_inline_chunk_bytes": (int, 1 << 20, "frame chunking for large messages"),
+    # --- collectives ---
+    "collective_chunk_bytes": (int, 1 << 20,
+                               "ring collectives split tensors into chunks "
+                               "of this size so chunk k+1 transmits while "
+                               "chunk k reduces (pipelining grain)"),
+    "collective_tree_threshold_bytes": (int, 32 << 10,
+                                        "payloads below this use a binomial "
+                                        "tree allreduce (latency-bound "
+                                        "regime) instead of the ring "
+                                        "(bandwidth-bound regime)"),
+    "collective_timeout_s": (float, 60.0,
+                             "default deadline of one collective call; a "
+                             "rank that dies mid-collective surfaces a "
+                             "TimeoutError on every survivor within this"),
+    "collective_call_ttl_s": (float, 120.0,
+                              "coordinator-side sweep: call records and "
+                              "mailbox posts older than this whose group "
+                              "members never completed/acked are dropped "
+                              "(a timed-out rank must not leak its "
+                              "partial contribution forever)"),
+    "collective_p2p_enabled": (bool, True,
+                               "route collective payloads peer-to-peer "
+                               "over the zero-copy transport; off = "
+                               "degenerate fallback through the "
+                               "coordinator actor (control plane)"),
     "object_transfer_chunk_bytes": (int, 8 << 20,
                                     "cross-host object pulls stream in "
                                     "chunks of this size (reference: "

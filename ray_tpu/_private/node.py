@@ -77,6 +77,13 @@ class _Worker:
     # OS pid from the REGISTER handshake, for workers this node did not
     # spawn itself (proc is None for those)
     pid: Optional[int] = None
+    # threads of this process currently parked in a blocking get(),
+    # whether or not the running record holds a CPU charge (an ACTOR
+    # method's record doesn't — the creation does). Workers counted
+    # here are exempt from the pool cap: an actor blocked on a nested
+    # actor creation (e.g. a collective-group coordinator) would
+    # otherwise deadlock a full pool that only it can unblock
+    blocked_gets: int = 0
     # registration deadline override (pip-env workers build a venv before
     # they can register; 0 = plain CONFIG.worker_register_timeout_s)
     register_timeout_s: float = 0.0
@@ -382,6 +389,15 @@ class _RemotePeer:
         except Exception:
             return None
 
+    def coll_forward(self, body: tuple) -> None:
+        """Forward one collective chunk to this peer's node, which
+        delivers it to the destination process (fire and forget — a
+        lost chunk surfaces as the receiving rank's deadline)."""
+        try:
+            self._chan.send(P.COLL_FWD, body)
+        except OSError:
+            pass
+
 
 class NodeService:
     """One per node. ``head=True`` also hosts the control plane."""
@@ -408,6 +424,18 @@ class NodeService:
         self._conns: Dict[int, P.Connection] = {}
         self._conn_kind: Dict[int, int] = {}
         self._conn_worker: Dict[int, WorkerID] = {}
+        # collective data plane routing: worker-id binary -> conn, for
+        # every registered process (workers AND drivers — a driver can
+        # be a collective rank). Written on the dispatcher (REGISTER /
+        # conn_closed), read on reader threads; dict ops are atomic.
+        self._coll_conns: Dict[bytes, P.Connection] = {}
+        self._conn_coll_wid: Dict[int, bytes] = {}
+        # node-id binary -> resolved peer handle for chunk forwarding:
+        # _peer() starts with a gcs.get_node (an RPC on non-head nodes)
+        # and the chunk plane must not pay a control-plane round trip
+        # per chunk; entries are revalidated by their own closed/dead
+        # flags, so a restarted peer re-resolves on first failure
+        self._coll_peers: Dict[bytes, Any] = {}
         self._next_conn_key = 1
         self._workers: Dict[WorkerID, _Worker] = {}
         self._idle: deque = deque()
@@ -966,7 +994,11 @@ class NodeService:
                              # thread, so neither may queue behind (or
                              # block) the dispatcher
                              P.STACK_REPLY, P.PROFILE_REPORT,
-                             P.CLUSTER_STACKS, P.CLUSTER_PROFILE})
+                             P.CLUSTER_STACKS, P.CLUSTER_PROFILE,
+                             # collective chunks are data plane: routed
+                             # on the arrival reader thread so a ring
+                             # step never queues behind task dispatch
+                             P.COLL_ROUTE, P.COLL_FWD})
 
     def _reader_loop(self, key: int, conn: P.Connection) -> None:
         while True:
@@ -1013,6 +1045,9 @@ class NodeService:
     def _handle_direct(self, key: int, op: int, payload: Any) -> None:
         if op == P.NODE_POST:
             self._events.put(tuple(payload))
+        elif op in (P.COLL_ROUTE, P.COLL_FWD):
+            dst_node, dst_wid, coll_key, data = payload
+            self._coll_route(dst_node, dst_wid, coll_key, data)
         elif op == P.OBJ_GET_META:
             req_id, oid, pin = payload
             meta = (self.store.pin_and_get(oid) if pin
@@ -1094,6 +1129,36 @@ class NodeService:
                 self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
             else:
                 self._reply(key, P.PUT_REPLY, (req_id,))
+
+    def _coll_route(self, dst_node: bytes, dst_wid: bytes, coll_key,
+                    data) -> None:
+        """Deliver one collective chunk: to a local process's conn when
+        the destination endpoint lives here, else across the node plane.
+        Runs on reader threads (data plane — never the dispatcher).
+        Fire and forget: an unroutable chunk (dead process/node) is
+        dropped and surfaces as the receiving rank's deadline."""
+        if dst_node == self.node_id.binary():
+            conn = self._coll_conns.get(dst_wid)
+            if conn is None:
+                return
+            try:
+                conn.send((P.COLL_DELIVER, (coll_key, data)))
+            except OSError:
+                pass
+            return
+        peer = self._coll_peers.get(dst_node)
+        if peer is not None and (peer.closed if isinstance(peer, _RemotePeer)
+                                 else peer.dead):
+            peer = None
+        if peer is None:
+            peer = self._peer(NodeID(dst_node))
+            if peer is None:
+                return
+            self._coll_peers[dst_node] = peer
+        if isinstance(peer, NodeService):
+            peer._coll_route(dst_node, dst_wid, coll_key, data)
+        else:
+            peer.coll_forward((dst_node, dst_wid, coll_key, data))
 
     def node_stats(self, what) -> Any:
         """Cross-thread node introspection (also served to peers).
@@ -1388,6 +1453,9 @@ class NodeService:
         if op == P.REGISTER:
             kind, worker_id, pid = payload
             self._conn_kind[key] = kind
+            # collective endpoint route for this process (drivers too)
+            self._coll_conns[bytes(worker_id)] = self._conns[key]
+            self._conn_coll_wid[key] = bytes(worker_id)
             if kind == P.KIND_WORKER:
                 wid = WorkerID(worker_id)
                 self._conn_worker[key] = wid
@@ -2157,11 +2225,18 @@ class NodeService:
         ``NotifyDirectCallTaskBlocked``)."""
         wid = self._conn_worker.get(conn_key)
         w = self._workers.get(wid) if wid is not None else None
-        rec = w.task if w is not None else None
-        if rec is None or rec.charge is None:
+        if w is None:
             return
-        cpu = rec.charge.get("CPU", 0.0)
+        w.blocked_gets += 1
+        rec = w.task
+        cpu = rec.charge.get("CPU", 0.0) if (
+            rec is not None and rec.charge is not None) else 0.0
         if not cpu:
+            # no CPU to return (actor method: the creation holds the
+            # charge) — but the pool-cap exemption just changed, and a
+            # parked actor creation may now have room to spawn into
+            if w.blocked_gets == 1:
+                self._dispatch()
             return
         rec.blocked_depth += 1
         if rec.blocked_depth > 1:
@@ -2215,7 +2290,11 @@ class NodeService:
     def _worker_unblocked(self, conn_key: int) -> None:
         wid = self._conn_worker.get(conn_key)
         w = self._workers.get(wid) if wid is not None else None
-        rec = w.task if w is not None else None
+        if w is None:
+            return
+        if w.blocked_gets > 0:
+            w.blocked_gets -= 1
+        rec = w.task
         if rec is None or rec.charge is None or rec.blocked_depth == 0:
             return
         rec.blocked_depth -= 1
@@ -2267,9 +2346,14 @@ class NodeService:
         # deep nested submission (recursion) parks a worker per level,
         # and capping on them deadlocks the leaves that would unblock
         # them (reference: WorkerPool grows past the cap while direct
-        # call workers are blocked)
+        # call workers are blocked). blocked_gets covers actors too —
+        # their method records hold no CPU charge so blocked_depth
+        # never rises, but an actor waiting on a nested actor creation
+        # (a collective-group coordinator, say) pins its process just
+        # the same
         active = sum(1 for w in self._workers.values()
                      if w.state != "DEAD"
+                     and not w.blocked_gets
                      and not (w.task is not None
                               and w.task.blocked_depth > 0))
         if active >= self._max_workers:
@@ -2862,8 +2946,12 @@ class NodeService:
         semantics (``actor.py:384``): an actor with no explicit
         resources charges 1 CPU while its __init__ runs — gating
         concurrent creations — and 0 afterwards (the charge is released
-        in ``_actor_creation_done``). PG-scheduled actors draw from
-        their bundle, where an implicit CPU may not exist."""
+        in ``_actor_creation_done``). An EXPLICIT num_cpus=0 arrives as
+        resources {"CPU": 0.0} and skips the implicit charge (0 for
+        creation AND running) — a 0-CPU helper actor must be creatable
+        on a saturated node or the busy actors waiting on it deadlock.
+        PG-scheduled actors draw from their bundle, where an implicit
+        CPU may not exist."""
         if spec.resources:
             return spec.resources
         if isinstance(spec.scheduling_strategy,
@@ -3399,8 +3487,13 @@ class NodeService:
 
     # ------------------------------------------------------- failure paths
     def _on_conn_closed(self, key: int) -> None:
-        self._conns.pop(key, None)
+        conn = self._conns.pop(key, None)
         self._driver_conn_keys.discard(key)
+        # retire the collective route only if it still points at THIS
+        # conn (a restarted process re-registers under the same id)
+        cwid = self._conn_coll_wid.pop(key, None)
+        if cwid is not None and self._coll_conns.get(cwid) is conn:
+            self._coll_conns.pop(cwid, None)
         # arena Creates this connection never sealed are garbage now
         self.store.reclaim_unsealed(key)
         # a dead consumer's parked stream requests: drop the waiters and

@@ -138,6 +138,18 @@ STACK_REPLY = 70        # worker/driver -> node: (token, dump dict)
 PROFILE_START = 71      # node -> worker push: (token, opts dict)
 PROFILE_REPORT = 72     # worker -> node: (token, report dict)
 
+# Collective data plane (reference analogues: the ring/tree schedules of
+# NCCL-backed ``util/collective`` — here the chunks ride the node plane).
+# A rank addresses a peer rank by (node_id, worker_id) endpoint; its node
+# routes each chunk either to a local process's conn or across the node
+# plane, and payload tensors travel out-of-band (pickle-5 iovecs) on
+# every hop. Handled on reader threads end to end — never the
+# dispatcher — so collective traffic cannot queue behind task dispatch.
+COLL_ROUTE = 74         # client -> node: (dst_node, dst_worker, key, payload)
+COLL_FWD = 75           # node -> node: same body, deliver on the dst node
+COLL_DELIVER = 76       # node -> client push: (key, payload) — deposited
+                        # into the process mailbox (coll_transport.py)
+
 # Generic coalesced frame: (BATCH, [(op, payload), ...]). Produced by
 # the Connection writer when several messages are pending at flush time
 # — ONE pickle stream + one frame + one receiver wakeup for the burst —
@@ -371,6 +383,12 @@ def _est_size(payload, depth: int = 3) -> int:
     inline = getattr(payload, "inline", None)
     if inline is not None:
         return len(inline) + 128
+    # numpy arrays (collective chunks) expose nbytes; without this a
+    # burst of 512KB chunks would estimate as 64B each and coalesce
+    # into one multi-MB BATCH frame
+    nbytes = getattr(payload, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes + 64
     return 64
 
 

@@ -56,7 +56,17 @@ def _build_resources(opts: Dict[str, Any], default_cpus: float) -> Dict[str, flo
             raise ValueError(
                 f"resource quantities over 1 must be whole numbers, "
                 f"got {k}={v}")
-    return {k: v for k, v in res.items() if v}
+    out = {k: v for k, v in res.items() if v}
+    if num_cpus is not None and "CPU" not in out:
+        # an EXPLICIT num_cpus=0 must survive into the spec: it opts the
+        # actor out of the implicit 1-CPU creation charge (reference:
+        # "default 1 for creation, 0 for running" — explicit 0 means
+        # 0/0). Without it a 0-CPU helper actor (e.g. a collective
+        # group's coordinator) can never start on a saturated node,
+        # deadlocking the very ranks that wait on it while holding
+        # every CPU.
+        out["CPU"] = 0.0
+    return out
 
 
 def _resolve_runtime_env(opts, client):

@@ -1,29 +1,50 @@
-"""Host-level collective communication over the object plane.
+"""Host-level collective communication: peer-to-peer pipelined rings.
 
 API mirrors the reference's ``util/collective/collective.py:258-615``
 (allreduce/allgather/reducescatter/broadcast/send/recv/barrier, group
 init by world_size+rank+group_name). Where the reference backs these
-with NCCL/Gloo process groups, here membership + rendezvous live in a
-named **coordinator actor** and payloads ride the shared-memory object
-store (zero-copy numpy) — the right transport for host arrays; device
-arrays inside one slice should use in-program XLA collectives instead.
+with NCCL/Gloo process groups, here the data plane is the node-plane
+zero-copy transport (``_private/coll_transport.py``): ranks exchange
+tensor chunks peer to peer as out-of-band pickle-5 iovecs, and
+completion is driven by connection reader threads waking condition
+variables — no polling anywhere on the data path.
 
-Reductions are computed once in the coordinator (numpy) rather than in a
-ring: host-level groups are small (one member per host), and one
-put+get through shm beats O(ranks) python-loop ring steps.
+Algorithms (reference model: "The Big Send-off" / bandwidth-optimal
+collective schedules):
+
+- **ring allreduce** = reduce-scatter + allgather over the rank ring,
+  tensors split into ``collective_chunk_bytes`` chunks so chunk k+1
+  transmits while chunk k reduces; per-rank wire traffic is
+  ~2x tensor size, independent of world size.
+- **ring reduce-scatter / allgather** reuse the two ring phases.
+- **binomial-tree broadcast** (chunk-pipelined down the tree) and a
+  small-payload **tree allreduce** below
+  ``collective_tree_threshold_bytes`` (latency-bound regime: 2·log2(w)
+  hops beat a 2·(w-1)-step ring).
+- **send/recv** are direct rank-to-rank mailbox messages.
+
+The named ``_Coordinator`` actor is control plane only: group
+membership, rank -> endpoint exchange, epoch agreement — plus a
+degenerate fallback data path (``collective_p2p_enabled=False`` or a
+rank with no runtime endpoint) that reduces by streaming pairwise
+accumulation on waiter futures (O(size) peak memory, no polling).
 """
 
 from __future__ import annotations
 
+import asyncio
+import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import get, get_actor, put
+from .. import get, get_actor
 from ..api import remote
+from .._private import coll_transport
 from .._private import telemetry
+from .._private.config import CONFIG
 
 _GROUP_ACTOR_PREFIX = "rtpu:collective:"
 
@@ -52,88 +73,180 @@ PROD = "prod"
 MIN = "min"
 MAX = "max"
 
-_REDUCERS = {
-    SUM: lambda arrs: np.sum(arrs, axis=0),
-    PROD: lambda arrs: np.prod(arrs, axis=0),
-    MIN: lambda arrs: np.min(arrs, axis=0),
-    MAX: lambda arrs: np.max(arrs, axis=0),
-}
+# binary ufuncs: streaming pairwise accumulation keeps peak memory at
+# O(size) (the seed's np.stack over world_size arrays was O(world*size))
+# and, unlike np.sum's axis reduction, never promotes the dtype
+_BINARY = {SUM: np.add, PROD: np.multiply, MIN: np.minimum, MAX: np.maximum}
 
 
-@remote(num_cpus=0)
-class _Coordinator:
-    """Rendezvous + reduction point for one collective group.
+class _CoordinatorImpl:
+    """Control plane of one collective group (async actor).
 
-    Each collective call is identified by (op_kind, seq). Members post
-    contributions; the call completes when world_size contributions have
-    arrived. Sequence numbers are tracked per member so reuse across
-    repeated calls is safe.
+    Owns membership (rank -> endpoint exchange under a fresh group
+    epoch) and the degenerate fallback data path. Every blocking call
+    awaits an ``asyncio.Event`` resolved by the completing member —
+    callers block on the actor reply, never on a poll loop. Call
+    records a timed-out rank abandoned (and mailbox posts never taken)
+    are swept once they outlive ``ttl_s``.
     """
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int, ttl_s: Optional[float] = None):
         self.world_size = world_size
+        self.epoch = os.urandom(8).hex()
+        self.ttl_s = float(ttl_s if ttl_s is not None
+                           else CONFIG.collective_call_ttl_s)
+        self._endpoints: Dict[int, Any] = {}
+        self._join_ev = asyncio.Event()
         self._calls: Dict[tuple, dict] = {}
-        self._mailbox: Dict[tuple, Any] = {}
+        self._mail: Dict[tuple, tuple] = {}          # key -> (value, born)
+        self._mail_evs: Dict[tuple, asyncio.Event] = {}
 
-    def _call(self, key):
+    def ping(self) -> bool:
+        return True
+
+    def debug_counts(self) -> Dict[str, int]:
+        """Test surface: live fallback-call records and mailbox posts."""
+        self._sweep()
+        return {"calls": len(self._calls), "mail": len(self._mail)}
+
+    def _sweep(self) -> None:
+        """Drop records older than the TTL: a rank that timed out of a
+        rendezvous leaves a partial record behind, and an un-taken post
+        has no reader — neither may live forever."""
+        now = time.monotonic()
+        for key, rec in list(self._calls.items()):
+            if now - rec["born"] > self.ttl_s:
+                rec["expired"] = True
+                rec["ev"].set()
+                del self._calls[key]
+        for key, (_value, born) in list(self._mail.items()):
+            if now - born > self.ttl_s:
+                del self._mail[key]
+
+    # ------------------------------------------------------- membership
+    async def join(self, rank: int, endpoint, timeout_s: float):
+        """Register this rank's endpoint; resolves for everyone once
+        all world_size ranks arrived. Returns (epoch, endpoints)."""
+        self._endpoints[rank] = (tuple(endpoint) if endpoint is not None
+                                 else None)
+        if len(self._endpoints) >= self.world_size:
+            self._join_ev.set()
+        else:
+            try:
+                await asyncio.wait_for(self._join_ev.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                missing = [r for r in range(self.world_size)
+                           if r not in self._endpoints]
+                return ("timeout",
+                        f"ranks {missing} never joined the group")
+        eps = [self._endpoints.get(r) for r in range(self.world_size)]
+        return ("ok", (self.epoch, eps))
+
+    # ------------------------------------------- fallback data path
+    def _call(self, key) -> dict:
         rec = self._calls.get(key)
         if rec is None:
-            rec = {"parts": {}, "result": None, "done": False}
+            rec = {"count": 0, "acc": None, "parts": {}, "result": None,
+                   "done": False, "taken": 0, "expired": False,
+                   "born": time.monotonic(), "ev": asyncio.Event()}
             self._calls[key] = rec
         return rec
 
-    def contribute(self, key, rank: int, value) -> None:
+    async def rendezvous(self, key, rank: int, value, op: Optional[str],
+                         timeout_s: float):
+        """Blocking rendezvous: contribution ``world_size`` resolves the
+        waiters. ``op`` None gathers parts (allgather/broadcast/barrier);
+        otherwise the reduction accumulates pairwise as values arrive."""
+        self._sweep()
         rec = self._call(key)
-        rec["parts"][rank] = value
-
-    def poll(self, key, op: Optional[str]):
-        """Returns (done, result). Computes the reduction exactly once."""
-        rec = self._call(key)
-        if rec["done"]:
-            return True, rec["result"]
-        if len(rec["parts"]) < self.world_size:
-            return False, None
-        parts = [rec["parts"][r] for r in range(self.world_size)]
-        if op is None:            # allgather / barrier: list of parts
-            rec["result"] = parts
+        if op is None:
+            # copy: the deserialized view may alias a store segment that
+            # is unpinned once this call returns
+            rec["parts"][rank] = (np.array(value)
+                                  if isinstance(value, np.ndarray)
+                                  else value)
         else:
-            stacked = np.stack([np.asarray(p) for p in parts])
-            # keep the contribution dtype: np.sum promotes int32->int64,
-            # but collectives contract to return what was put in (NCCL
-            # semantics)
-            rec["result"] = _REDUCERS[op](stacked).astype(
-                stacked.dtype, copy=False)
-        rec["done"] = True
-        rec["acks"] = set()
-        return True, rec["result"]
+            v = np.asarray(value)
+            rec["acc"] = (np.array(v) if rec["acc"] is None
+                          else _BINARY[op](rec["acc"], v))
+        rec["count"] += 1
+        if rec["count"] >= self.world_size:
+            rec["result"] = (rec["acc"] if op is not None else
+                             [rec["parts"].get(r)
+                              for r in range(self.world_size)])
+            rec["done"] = True
+            rec["ev"].set()
+        elif not rec["done"]:
+            try:
+                await asyncio.wait_for(rec["ev"].wait(), timeout_s)
+            except asyncio.TimeoutError:
+                # leave the partial record for the TTL sweep
+                return ("timeout",
+                        f"{rec['count']}/{self.world_size} ranks arrived")
+        if rec["expired"]:
+            return ("timeout", "call record expired (TTL sweep)")
+        rec["taken"] += 1
+        if rec["taken"] >= self.world_size:
+            self._calls.pop(key, None)
+        return ("ok", rec["result"])
 
-    def ack(self, key, rank: int) -> None:
-        rec = self._calls.get(key)
-        if rec is None:
-            return
-        rec.setdefault("acks", set()).add(rank)
-        if len(rec["acks"]) >= self.world_size:
-            del self._calls[key]
+    async def post(self, dst_rank: int, tag, value) -> None:
+        self._sweep()
+        key = (dst_rank, tuple(tag))
+        self._mail[key] = (np.array(value)
+                           if isinstance(value, np.ndarray) else value,
+                           time.monotonic())
+        ev = self._mail_evs.get(key)
+        if ev is not None:
+            ev.set()
 
-    def post(self, dst_rank: int, tag, value) -> None:
-        self._mailbox[(dst_rank, tag)] = value
+    async def take(self, dst_rank: int, tag, timeout_s: float):
+        self._sweep()
+        key = (dst_rank, tuple(tag))
+        if key not in self._mail:
+            ev = self._mail_evs.get(key)
+            if ev is None:
+                ev = self._mail_evs[key] = asyncio.Event()
+            try:
+                await asyncio.wait_for(ev.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                return ("timeout", f"no message for tag {tag}")
+            finally:
+                self._mail_evs.pop(key, None)
+        if key not in self._mail:            # raced the TTL sweep
+            return ("timeout", "message expired (TTL sweep)")
+        value, _born = self._mail.pop(key)
+        return ("ok", value)
 
-    def take(self, dst_rank: int, tag):
-        if (dst_rank, tag) in self._mailbox:
-            return True, self._mailbox.pop((dst_rank, tag))
-        return False, None
+
+_Coordinator = remote(num_cpus=0)(_CoordinatorImpl)
 
 
 class _GroupState:
-    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+    def __init__(self, name: str, world_size: int, rank: int, coordinator,
+                 epoch: str, endpoints: List[Any]):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.coordinator = coordinator
+        self.epoch = epoch
+        self.endpoints = endpoints
+        # p2p only when every rank published a routable endpoint (all
+        # ranks derive this from the same exchanged data, so the whole
+        # group agrees on the schedule)
+        self.use_p2p = all(ep is not None for ep in endpoints)
         self.seq = 0
         # p2p sequence counters keyed by (peer_rank, tag)
         self.send_seq: Dict[tuple, int] = {}
         self.recv_seq: Dict[tuple, int] = {}
+
+    def next_seq(self) -> int:
+        seq = self.seq
+        self.seq += 1
+        return seq
+
+    def key(self, seq: int) -> tuple:
+        return (self.name, self.epoch, seq)
 
 
 # Per-process registry (module-global like the reference's GroupManager,
@@ -146,12 +259,23 @@ def _groups() -> Dict[str, _GroupState]:
     return _process_groups
 
 
+def _coord(state_or_actor, method: str, *args):
+    """Call a coordinator method and unwrap its ("ok"|"timeout", x)
+    status tuple; "timeout" raises here so every rank surfaces it."""
+    res = get(getattr(state_or_actor, method).remote(*args))
+    if res[0] != "ok":
+        raise TimeoutError(f"collective {method}: {res[1]}")
+    return res[1]
+
+
 def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
     """Join a collective group (reference: ``collective.py:120``).
 
     Call from every member actor/task with a distinct ``rank``. Rank 0
-    creates the named coordinator actor; others look it up.
+    creates the named coordinator actor; others look it up. All members
+    then exchange (rank -> endpoint) through the coordinator, which is
+    what the peer-to-peer ring/tree schedules route on.
     """
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
@@ -160,11 +284,11 @@ def init_collective_group(world_size: int, rank: int,
     if rank == 0:
         coordinator = _Coordinator.options(name=actor_name).remote(world_size)
         # touch it so registration completes before others look it up
-        get(coordinator.take.remote(-1, "warmup"))
+        get(coordinator.ping.remote())
     else:
         deadline = time.monotonic() + 30.0
-        while True:
-            try:
+        while True:                 # control plane (init only): the
+            try:                    # data path never polls
                 coordinator = get_actor(actor_name)
                 break
             except ValueError:
@@ -173,9 +297,13 @@ def init_collective_group(world_size: int, rank: int,
                         f"collective group {group_name!r}: coordinator "
                         "never appeared (is rank 0 up?)")
                 time.sleep(0.02)
+    ep = (coll_transport.local_endpoint()
+          if CONFIG.collective_p2p_enabled else None)
+    epoch, endpoints = _coord(coordinator, "join", rank, ep,
+                              CONFIG.collective_timeout_s)
     with _groups_lock:
-        _process_groups[group_name] = _GroupState(group_name, world_size,
-                                                  rank, coordinator)
+        _process_groups[group_name] = _GroupState(
+            group_name, world_size, rank, coordinator, epoch, endpoints)
 
 
 class CollectiveActorMixin:
@@ -185,6 +313,9 @@ class CollectiveActorMixin:
     def _rtpu_init_collective(self, world_size: int, rank: int,
                               group_name: str) -> None:
         init_collective_group(world_size, rank, group_name)
+
+    def _rtpu_destroy_collective(self, group_name: str) -> None:
+        destroy_collective_group(group_name)
 
 
 def create_collective_group(actors: List[Any], world_size: int,
@@ -215,7 +346,10 @@ def create_collective_group(actors: List[Any], world_size: int,
 def destroy_collective_group(group_name: str = "default") -> None:
     with _groups_lock:
         state = _process_groups.pop(group_name, None)
-    if state is not None and state.rank == 0:
+    if state is None:
+        return
+    coll_transport.drop_group(state.name, state.epoch)
+    if state.rank == 0:
         from .. import kill
         try:
             kill(state.coordinator)
@@ -242,114 +376,400 @@ def _state(group_name: str) -> _GroupState:
     return state
 
 
-def _rendezvous(state: _GroupState, kind: str, payload, op: Optional[str],
-                timeout: float = 60.0):
-    key = (kind, state.seq)
-    state.seq += 1
-    get(state.coordinator.contribute.remote(key, state.rank, payload))
-    deadline = time.monotonic() + timeout
-    delay = 0.001
-    while True:
-        done, result = get(state.coordinator.poll.remote(key, op))
-        if done:
-            state.coordinator.ack.remote(key, state.rank)
-            return result
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"collective {kind} in group {state.name!r} timed out "
-                f"(rank {state.rank})")
-        time.sleep(delay)
-        delay = min(delay * 2, 0.05)
-
-
 def _to_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
-def allreduce(tensor, group_name: str = "default", op: str = SUM):
+def _deadline(timeout: Optional[float]) -> float:
+    return time.monotonic() + (timeout if timeout is not None
+                               else CONFIG.collective_timeout_s)
+
+
+def _timeout_s(timeout: Optional[float]) -> float:
+    return timeout if timeout is not None else CONFIG.collective_timeout_s
+
+
+# --------------------------------------------------------- ring schedules
+#
+# Ring convention (delta = -1): at reduce-scatter step s, rank r sends
+# segment (r-1-s) mod w and receives segment (r-2-s) mod w from its left
+# neighbor, reducing it into the local buffer — after w-1 steps rank r
+# holds segment r fully reduced. The allgather phase then circulates the
+# finished segments the same way. Chunks pipeline: a chunk is forwarded
+# the moment it is reduced, so chunk k+1 is on the wire while chunk k
+# reduces, and a chunk's buffer is never mutated again until the data
+# derived from it has causally passed through the next rank (which makes
+# the zero-copy views safe).
+
+def _chunk_ranges(a: int, b: int, chunk_elems: int) -> List[Tuple[int, int]]:
+    out = []
+    while a < b:
+        e = min(a + chunk_elems, b)
+        out.append((a, e))
+        a = e
+    return out
+
+
+def _chunk_elems(dtype) -> int:
+    return max(1, CONFIG.collective_chunk_bytes // max(1, dtype.itemsize))
+
+
+def _send(state: _GroupState, dst_rank: int, key: tuple, payload,
+          op: str) -> None:
+    coll_transport.send(state.endpoints[dst_rank], key, payload,
+                        group=state.name, op=op)
+
+
+def _ring_reduce_scatter(state: _GroupState, buf: np.ndarray,
+                         bounds: List[int], op: str, key: tuple,
+                         deadline: float, opname: str) -> None:
+    """In-place ring reduce-scatter over ``buf`` segments ``bounds``;
+    on return segment ``rank`` holds the full reduction."""
+    w, r = state.world_size, state.rank
+    right = (r + 1) % w
+    ce = _chunk_elems(buf.dtype)
+    binop = _BINARY[op]
+
+    def chunks(seg: int) -> List[Tuple[int, int]]:
+        return _chunk_ranges(bounds[seg], bounds[seg + 1], ce)
+
+    first = (r - 1) % w
+    for ci, (a, b) in enumerate(chunks(first)):
+        _send(state, right, key + ("rs", first, ci), buf[a:b], opname)
+    for s in range(w - 1):
+        seg = (r - 2 - s) % w
+        for ci, (a, b) in enumerate(chunks(seg)):
+            data = coll_transport.wait(key + ("rs", seg, ci), deadline)
+            view = buf[a:b]
+            binop(view, np.asarray(data), out=view)
+            if s < w - 2:
+                # forward the just-reduced chunk while the next chunk
+                # of this segment is still in flight (pipelining)
+                _send(state, right, key + ("rs", seg, ci), view, opname)
+
+
+def _ring_allgather_segments(state: _GroupState, buf: np.ndarray,
+                             bounds: List[int], key: tuple,
+                             deadline: float, opname: str) -> None:
+    """Ring allgather of ``buf`` segments: each rank starts with its own
+    segment final (post reduce-scatter) and circulates; on return every
+    segment of ``buf`` is final."""
+    w, r = state.world_size, state.rank
+    right = (r + 1) % w
+    ce = _chunk_elems(buf.dtype)
+
+    def chunks(seg: int) -> List[Tuple[int, int]]:
+        return _chunk_ranges(bounds[seg], bounds[seg + 1], ce)
+
+    for ci, (a, b) in enumerate(chunks(r)):
+        _send(state, right, key + ("ag", r, ci), buf[a:b], opname)
+    for s in range(w - 1):
+        seg = (r - 1 - s) % w
+        for ci, (a, b) in enumerate(chunks(seg)):
+            data = coll_transport.wait(key + ("ag", seg, ci), deadline)
+            if s < w - 2:
+                # forward the received (zero-copy) view untouched
+                _send(state, right, key + ("ag", seg, ci), data, opname)
+            buf[a:b] = np.asarray(data)
+
+
+# --------------------------------------------------------- tree schedules
+
+def _tree_parent_children(v: int, w: int) -> Tuple[Optional[int], List[int]]:
+    """Binomial tree rooted at virtual rank 0: parent clears v's lowest
+    set bit; children are v + m for descending m below it."""
+    if v == 0:
+        lsb = 1
+        while lsb < w:
+            lsb <<= 1
+        parent = None
+    else:
+        lsb = v & -v
+        parent = v - lsb
+    children = []
+    m = lsb >> 1
+    while m:
+        if v + m < w:
+            children.append(v + m)
+        m >>= 1
+    return parent, children
+
+
+def _tree_reduce(state: _GroupState, arr: np.ndarray, op: str, key: tuple,
+                 deadline: float, opname: str) -> Optional[np.ndarray]:
+    """Binomial-tree reduction to rank 0; returns the total at rank 0,
+    None elsewhere (small payloads: whole arrays per hop)."""
+    w, r = state.world_size, state.rank
+    binop = _BINARY[op]
+    acc = np.array(arr)
+    mask = 1
+    while mask < w:
+        if r & mask:
+            _send(state, r - mask, key + ("tr", r), acc, opname)
+            return None
+        peer = r | mask
+        if peer < w:
+            data = coll_transport.wait(key + ("tr", peer), deadline)
+            acc = binop(acc, np.asarray(data))
+        mask <<= 1
+    return acc
+
+
+def _tree_bcast_small(state: _GroupState, data, src_rank: int, key: tuple,
+                      deadline: float, opname: str) -> np.ndarray:
+    """Whole-payload binomial broadcast (small/known-shape payloads)."""
+    w, r = state.world_size, state.rank
+    v = (r - src_rank) % w
+    parent, children = _tree_parent_children(v, w)
+    if parent is not None:
+        data = coll_transport.wait(key + ("tb", v), deadline)
+    for c in children:
+        _send(state, (c + src_rank) % w, key + ("tb", c), data, opname)
+    return np.asarray(data)
+
+
+def _tree_bcast_chunked(state: _GroupState, value: Optional[np.ndarray],
+                        src_rank: int, key: tuple, deadline: float,
+                        opname: str) -> np.ndarray:
+    """Chunk-pipelined binomial broadcast: non-source ranks learn the
+    shape from a header, then each chunk is forwarded down the tree the
+    moment it arrives (chunk k+1 rides the wire while k lands)."""
+    w, r = state.world_size, state.rank
+    v = (r - src_rank) % w
+    parent, children = _tree_parent_children(v, w)
+
+    def fanout(subkey: tuple, payload) -> None:
+        for c in children:
+            _send(state, (c + src_rank) % w, key + subkey + (c,), payload,
+                  opname)
+
+    if parent is None:
+        flat = np.ascontiguousarray(value).reshape(-1)
+        ranges = _chunk_ranges(0, flat.size, _chunk_elems(flat.dtype))
+        header = (value.shape, flat.dtype.str, len(ranges))
+        fanout(("bh",), header)
+        for ci, (a, b) in enumerate(ranges):
+            fanout(("bc", ci), flat[a:b])
+        return np.asarray(value)
+    shape, dtype_str, nchunks = coll_transport.wait(
+        key + ("bh", v), deadline)
+    fanout(("bh",), (shape, dtype_str, nchunks))
+    buf = np.empty(int(np.prod(shape, dtype=np.int64)),
+                   dtype=np.dtype(dtype_str))
+    pos = 0
+    for ci in range(nchunks):
+        data = coll_transport.wait(key + ("bc", ci, v), deadline)
+        fanout(("bc", ci), data)
+        arr = np.asarray(data)
+        buf[pos:pos + arr.size] = arr
+        pos += arr.size
+    return buf.reshape(tuple(shape))
+
+
+# ------------------------------------------------------------- public API
+
+def allreduce(tensor, group_name: str = "default", op: str = SUM,
+              timeout: Optional[float] = None):
     """All-reduce; returns the reduced array (reference mutates in place —
-    functional style here, jax arrays are immutable)."""
+    functional style here, jax arrays are immutable). Ring reduce-scatter
+    + allgather above ``collective_tree_threshold_bytes``, binomial tree
+    below it; every rank returns bit-identical bytes."""
     state = _state(group_name)
     arr = _to_numpy(tensor)
     t0 = time.monotonic()
-    # Large payloads ride the object store; the coordinator sees refs
-    # transparently because args are resolved at task execution.
-    result = _rendezvous(state, "allreduce", put(arr), op)
+    seq = state.next_seq()
+    if state.world_size == 1:
+        result = np.array(arr)
+    elif not state.use_p2p:
+        result = np.asarray(_coord(state.coordinator, "rendezvous",
+                                   state.key(seq), state.rank, arr, op,
+                                   _timeout_s(timeout)))
+    elif arr.nbytes < CONFIG.collective_tree_threshold_bytes:
+        key, deadline = state.key(seq), _deadline(timeout)
+        total = _tree_reduce(state, arr, op, key, deadline, "allreduce")
+        result = _tree_bcast_small(state, total, 0, key, deadline,
+                                   "allreduce").reshape(arr.shape)
+        # the fanned-out buffer aliases the returned array (root) — the
+        # caller may mutate it the moment we return, so the zero-copy
+        # sends must have left this process first
+        coll_transport.flush()
+    else:
+        key, deadline = state.key(seq), _deadline(timeout)
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        buf = flat.copy()
+        n = buf.size
+        w = state.world_size
+        bounds = [(i * n) // w for i in range(w + 1)]
+        _ring_reduce_scatter(state, buf, bounds, op, key, deadline,
+                             "allreduce")
+        _ring_allgather_segments(state, buf, bounds, key, deadline,
+                                 "allreduce")
+        # allgather-phase sends are views of ``buf``, which the caller
+        # receives (and may mutate) as the result — flush before return
+        coll_transport.flush()
+        result = buf.reshape(arr.shape)
     _observe("allreduce", group_name, arr.nbytes, t0)
     return result
 
 
-def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+def allgather(tensor, group_name: str = "default",
+              timeout: Optional[float] = None) -> List[np.ndarray]:
+    """Gather every rank's array (whole contributions circulate the
+    ring; output is inherently O(world * size))."""
     state = _state(group_name)
     arr = _to_numpy(tensor)
     t0 = time.monotonic()
-    parts = _rendezvous(state, "allgather", put(arr), None)
+    seq = state.next_seq()
+    w, r = state.world_size, state.rank
+    if w == 1:
+        parts: List[np.ndarray] = [np.array(arr)]
+    elif not state.use_p2p:
+        parts = [np.asarray(p) for p in _coord(
+            state.coordinator, "rendezvous", state.key(seq), r, arr,
+            None, _timeout_s(timeout))]
+    else:
+        key, deadline = state.key(seq), _deadline(timeout)
+        out: List[Any] = [None] * w
+        out[r] = arr
+        right = (r + 1) % w
+        _send(state, right, key + ("ga", r), arr, "allgather")
+        for s in range(w - 1):
+            src = (r - 1 - s) % w
+            data = coll_transport.wait(key + ("ga", src), deadline)
+            if s < w - 2:
+                _send(state, right, key + ("ga", src), data, "allgather")
+            out[src] = np.asarray(data)
+        # the caller's own ``arr`` went onto the ring zero-copy and the
+        # caller may mutate it once we return — flush the link first
+        coll_transport.flush()
+        parts = [np.asarray(p) for p in out]
     _observe("allgather", group_name, arr.nbytes, t0)
-    return [np.asarray(p) for p in parts]
+    return parts
 
 
-def reducescatter(tensor, group_name: str = "default", op: str = SUM):
-    """Reduce then return this rank's 1/world_size slice along axis 0."""
+def reducescatter(tensor, group_name: str = "default", op: str = SUM,
+                  timeout: Optional[float] = None):
+    """Reduce then return this rank's 1/world_size slice along axis 0
+    (ring reduce-scatter: each rank receives only its own slice's
+    traffic, ~1x tensor size per rank)."""
     state = _state(group_name)
     arr = _to_numpy(tensor)
     t0 = time.monotonic()
-    reduced = np.asarray(_rendezvous(state, "reducescatter",
-                                     put(arr), op))
-    _observe("reducescatter", group_name, arr.nbytes, t0)
-    if reduced.shape[0] % state.world_size:
+    seq = state.next_seq()
+    w, r = state.world_size, state.rank
+    if arr.ndim == 0 or arr.shape[0] % w:
         raise ValueError(
-            f"reducescatter: leading dim {reduced.shape[0]} not divisible "
-            f"by world size {state.world_size}")
-    chunk = reduced.shape[0] // state.world_size
-    return reduced[state.rank * chunk:(state.rank + 1) * chunk]
+            f"reducescatter: leading dim {arr.shape[:1]} not divisible "
+            f"by world size {w}")
+    rows = arr.shape[0] // w
+    if w == 1:
+        result = np.array(arr)
+    elif not state.use_p2p:
+        reduced = np.asarray(_coord(state.coordinator, "rendezvous",
+                                    state.key(seq), r, arr, op,
+                                    _timeout_s(timeout)))
+        result = reduced[r * rows:(r + 1) * rows]
+    else:
+        key, deadline = state.key(seq), _deadline(timeout)
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        buf = flat.copy()
+        seg_elems = rows * (flat.size // arr.shape[0])
+        bounds = [i * seg_elems for i in range(w + 1)]
+        _ring_reduce_scatter(state, buf, bounds, op, key, deadline,
+                             "reducescatter")
+        result = buf[bounds[r]:bounds[r + 1]].reshape(
+            (rows,) + arr.shape[1:]).copy()
+    _observe("reducescatter", group_name, arr.nbytes, t0)
+    return result
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout: Optional[float] = None):
+    """Binomial-tree broadcast from ``src_rank``, chunk-pipelined down
+    the tree; non-source ranks' tensors are ignored (shape/dtype arrive
+    in the header)."""
     state = _state(group_name)
     arr = _to_numpy(tensor)
     t0 = time.monotonic()
-    payload = put(arr) if state.rank == src_rank else None
-    parts = _rendezvous(state, "broadcast", payload, None)
-    _observe("broadcast", group_name,
-             arr.nbytes if state.rank == src_rank else 0, t0)
-    return np.asarray(parts[src_rank])
+    seq = state.next_seq()
+    is_src = state.rank == src_rank
+    if state.world_size == 1:
+        result = np.array(arr)
+    elif not state.use_p2p:
+        parts = _coord(state.coordinator, "rendezvous", state.key(seq),
+                       state.rank, arr if is_src else None, None,
+                       _timeout_s(timeout))
+        result = np.asarray(parts[src_rank])
+    else:
+        result = _tree_bcast_chunked(state, arr if is_src else None,
+                                     src_rank, state.key(seq),
+                                     _deadline(timeout), "broadcast")
+        # the source fans out zero-copy views of the caller's tensor
+        # (contiguous input: ascontiguousarray is a no-copy) — it must
+        # be on the wire before the caller can touch it again
+        coll_transport.flush()
+    _observe("broadcast", group_name, arr.nbytes if is_src else 0, t0)
+    return result
 
 
-def barrier(group_name: str = "default") -> None:
+def barrier(group_name: str = "default",
+            timeout: Optional[float] = None) -> None:
+    """All ranks block until every rank arrived (tree reduce + tree
+    broadcast of an empty token — 2·log2(w) hops)."""
     state = _state(group_name)
     t0 = time.monotonic()
-    _rendezvous(state, "barrier", None, None)
+    seq = state.next_seq()
+    if state.world_size == 1:
+        pass
+    elif not state.use_p2p:
+        _coord(state.coordinator, "rendezvous", state.key(seq),
+               state.rank, None, None, _timeout_s(timeout))
+    else:
+        key, deadline = state.key(seq), _deadline(timeout)
+        token = np.zeros(1, dtype=np.uint8)
+        total = _tree_reduce(state, token, SUM, key, deadline, "barrier")
+        _tree_bcast_small(state, total, 0, key, deadline, "barrier")
     _observe("barrier", group_name, 0, t0)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default",
          tag: int = 0) -> None:
+    """Direct rank-to-rank send: one mailbox message straight to the
+    destination rank's process (no coordinator hop)."""
     state = _state(group_name)
     seq = state.send_seq.get((dst_rank, tag), 0)
     state.send_seq[(dst_rank, tag)] = seq + 1
     arr = _to_numpy(tensor)
     t0 = time.monotonic()
-    get(state.coordinator.post.remote(
-        dst_rank, (state.rank, tag, seq), put(arr)))
+    if state.use_p2p:
+        _send(state, dst_rank,
+              (state.name, state.epoch, "p2p", state.rank, dst_rank,
+               tag, seq), arr, "send")
+        # ``arr`` aliases the caller's tensor (zero-copy); send() must
+        # not return while it can still be pickled later by a drainer
+        coll_transport.flush()
+    else:
+        get(state.coordinator.post.remote(
+            dst_rank, (state.rank, tag, seq), arr))
     _observe("send", group_name, arr.nbytes, t0)
 
 
 def recv(src_rank: int, group_name: str = "default", tag: int = 0,
-         timeout: float = 60.0):
+         timeout: Optional[float] = None):
+    """Blocking receive of the matching ``send`` (FIFO per (src, tag));
+    wakes on delivery, raises TimeoutError at the deadline."""
     state = _state(group_name)
     seq = state.recv_seq.get((src_rank, tag), 0)
     state.recv_seq[(src_rank, tag)] = seq + 1
     t0 = time.monotonic()
-    deadline = time.monotonic() + timeout
-    delay = 0.001
-    while True:
-        ok, value = get(state.coordinator.take.remote(
-            state.rank, (src_rank, tag, seq)))
-        if ok:
-            arr = np.asarray(value)
-            _observe("recv", group_name, arr.nbytes, t0)
-            return arr
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"recv from rank {src_rank} timed out")
-        time.sleep(delay)
-        delay = min(delay * 2, 0.05)
+    if state.use_p2p:
+        data = coll_transport.wait(
+            (state.name, state.epoch, "p2p", src_rank, state.rank,
+             tag, seq), _deadline(timeout), what="p2p recv")
+        arr = np.array(data)
+    else:
+        arr = np.asarray(_coord(state.coordinator, "take", state.rank,
+                                (src_rank, tag, seq),
+                                _timeout_s(timeout)))
+    _observe("recv", group_name, arr.nbytes, t0)
+    return arr

@@ -19,21 +19,55 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional, Sequence
 
 from .. import get
+from .collective import CollectiveActorMixin
 from ..util.placement_group import (PlacementGroup, placement_group,
                                     remove_placement_group)
 from ..util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
 
-class SPMDWorkerBase:
+class SPMDWorkerBase(CollectiveActorMixin):
     """Base for user host-actors in a MeshGroup.
 
     Subclasses get `self.mesh_rank` / `self.mesh_world` and can build a
-    local `jax.sharding.Mesh` via `build_local_mesh()`.
+    local `jax.sharding.Mesh` via `build_local_mesh()`. When the group
+    was created with ``collective_group=...`` the host actors also form
+    a host-level collective group (peer-to-peer ring/tree schedules over
+    the node plane — see ``comm/collective.py``) and the ``mesh_*``
+    helpers below run over it: host-side gradient/metric sync for the
+    DCN axis, complementing the ICI collectives XLA runs inside jitted
+    programs.
     """
 
-    def _rtpu_setup_mesh(self, rank: int, world: int) -> None:
+    mesh_coll_group: Optional[str] = None
+
+    def _rtpu_setup_mesh(self, rank: int, world: int,
+                         coll_group: Optional[str] = None) -> None:
         self.mesh_rank = rank
         self.mesh_world = world
+        self.mesh_coll_group = coll_group
+        if coll_group is not None:
+            self._rtpu_init_collective(world, rank, coll_group)
+
+    def _mesh_group_name(self) -> str:
+        if self.mesh_coll_group is None:
+            raise RuntimeError(
+                "this MeshGroup was created without collective_group=; "
+                "host-level mesh_* collectives are not wired")
+        return self.mesh_coll_group
+
+    def mesh_allreduce(self, tensor, op: str = "sum"):
+        from . import collective as col
+        return col.allreduce(tensor, group_name=self._mesh_group_name(),
+                             op=op)
+
+    def mesh_broadcast(self, tensor, src_rank: int = 0):
+        from . import collective as col
+        return col.broadcast(tensor, src_rank=src_rank,
+                             group_name=self._mesh_group_name())
+
+    def mesh_barrier(self) -> None:
+        from . import collective as col
+        col.barrier(group_name=self._mesh_group_name())
 
     def build_local_mesh(self, spec=None):
         from ..parallel.mesh import build_mesh
@@ -44,10 +78,14 @@ class MeshGroup:
     """A gang of host actors driven in lockstep SPMD calls."""
 
     def __init__(self, actors: List[Any],
-                 pg: Optional[PlacementGroup] = None):
+                 pg: Optional[PlacementGroup] = None,
+                 collective_group: Optional[str] = None):
         self._actors = actors
         self._pg = pg
-        refs = [a._rtpu_setup_mesh.remote(i, len(actors))
+        self.collective_group = collective_group
+        # all ranks are driven concurrently: rank 0's init creates the
+        # group coordinator and later ranks block on its appearance
+        refs = [a._rtpu_setup_mesh.remote(i, len(actors), collective_group)
                 for i, a in enumerate(actors)]
         get(refs)
 
@@ -77,6 +115,14 @@ class MeshGroup:
 
     def shutdown(self) -> None:
         from .. import kill
+        if self.collective_group is not None:
+            # rank 0's process owns the coordinator actor; ask it to
+            # tear the group down before the gang dies
+            try:
+                get(self._actors[0]._rtpu_destroy_collective.remote(
+                    self.collective_group))
+            except Exception:
+                pass
         for a in self._actors:
             try:
                 kill(a)
@@ -90,12 +136,16 @@ def mesh_group(actor_cls, num_hosts: int,
                resources_per_host: Optional[dict] = None,
                strategy: str = "STRICT_SPREAD",
                actor_args: Sequence[Any] = (),
-               actor_kwargs: Optional[dict] = None) -> MeshGroup:
+               actor_kwargs: Optional[dict] = None,
+               collective_group: Optional[str] = None) -> MeshGroup:
     """Gang-schedule `num_hosts` host actors, one per placement bundle.
 
     `actor_cls` must be a `@ray_tpu.remote` class whose implementation
     inherits `SPMDWorkerBase`. STRICT_SPREAD puts one host actor per
     node — the TPU-pod shape (one worker per TPU-VM host).
+    ``collective_group`` additionally joins the hosts into a named
+    host-level collective group (ring/tree schedules over the node
+    plane) usable via the ``mesh_*`` helpers.
     """
     bundle = dict(resources_per_host or {"CPU": 1})
     pg = placement_group([bundle] * num_hosts, strategy=strategy)
@@ -114,7 +164,7 @@ def mesh_group(actor_cls, num_hosts: int,
                 opts["resources"] = extra
             actors.append(actor_cls.options(**opts).remote(*actor_args,
                                                            **actor_kwargs))
-        return MeshGroup(actors, pg=pg)
+        return MeshGroup(actors, pg=pg, collective_group=collective_group)
     except Exception:
         # don't leak the gang reservation (or stragglers) on failure
         from .. import kill

@@ -72,6 +72,10 @@ def readme_metric_names(readme_path: str) -> Set[str]:
 
 _REGISTRY_ROW_RE = re.compile(
     r"^\|\s*`(rtpu_[a-z0-9_]+)`\s*\|\s*(\w+)\s*\|", re.MULTILINE)
+_REGISTRY_LABEL_ROW_RE = re.compile(
+    r"^\|\s*`(rtpu_[a-z0-9_]+)`\s*\|\s*\w+\s*\|\s*([^|]*)\|", re.MULTILINE)
+_LABEL_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+_TAG_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 
 def readme_registry_types(readme_path: str) -> Dict[str, str]:
@@ -108,6 +112,79 @@ def collect_defined_metric_kinds(pkg_dir: str,
                     and isinstance(name_arg.value, str)
                     and name_arg.value.startswith("rtpu_")):
                 out[name_arg.value] = (kind_arg.value, rel)
+    return out
+
+
+def readme_registry_labels(readme_path: str) -> Dict[str, Set[str]]:
+    """Metric name -> documented label set from the registry table's
+    labels column (``—`` rows map to the empty set)."""
+    try:
+        with open(readme_path) as f:
+            text = f.read()
+    except OSError:
+        return {}
+    return {name: set(_LABEL_NAME_RE.findall(cell))
+            for name, cell in _REGISTRY_LABEL_ROW_RE.findall(text)}
+
+
+def collect_used_tag_keys(pkg_dir: str,
+                          files=None) -> Dict[str, Dict[str, str]]:
+    """Metric name -> {tag key -> file} for every literal ``tags=(("k",
+    v), ...)`` passed to ``counter_inc``/``gauge_set``/``hist_observe``
+    whose metric argument is a name bound by ``X = telemetry.define(
+    kind, "rtpu_...", ...)``. Dynamic tag expressions are skipped — the
+    lint only judges what it can read statically."""
+    files = list(files if files is not None else _walk_files(pkg_dir))
+    # pass 1: variable name -> metric name (module-scope define binds)
+    var_to_metric: Dict[str, str] = {}
+    for _rel, tree in files:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            fn = node.value.func
+            fname = (fn.attr if isinstance(fn, ast.Attribute)
+                     else fn.id if isinstance(fn, ast.Name) else None)
+            if fname != "define" or len(node.value.args) < 2:
+                continue
+            arg = node.value.args[1]
+            if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                    and arg.value.startswith("rtpu_")):
+                var_to_metric[node.targets[0].id] = arg.value
+    # pass 2: record-site tag keys
+    out: Dict[str, Dict[str, str]] = {}
+    for rel, tree in files:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            fname = (fn.attr if isinstance(fn, ast.Attribute)
+                     else fn.id if isinstance(fn, ast.Name) else None)
+            if fname not in ("counter_inc", "gauge_set", "hist_observe"):
+                continue
+            metric_arg = node.args[0]
+            var = (metric_arg.attr if isinstance(metric_arg, ast.Attribute)
+                   else metric_arg.id if isinstance(metric_arg, ast.Name)
+                   else None)
+            metric = var_to_metric.get(var or "")
+            if metric is None:
+                continue
+            tags_node = None
+            if len(node.args) >= 3:
+                tags_node = node.args[2]
+            for kw in node.keywords:
+                if kw.arg == "tags":
+                    tags_node = kw.value
+            if not isinstance(tags_node, (ast.Tuple, ast.List)):
+                continue
+            for pair in tags_node.elts:
+                if not (isinstance(pair, (ast.Tuple, ast.List))
+                        and pair.elts
+                        and isinstance(pair.elts[0], ast.Constant)
+                        and isinstance(pair.elts[0].value, str)):
+                    continue
+                out.setdefault(metric, {})[pair.elts[0].value] = rel
     return out
 
 
@@ -232,6 +309,23 @@ def check(repo_root: str = None) -> List[str]:
             problems.append(
                 f"{name} ({where}): defined as {kind} but the README "
                 f"registry row says {doc_type}")
+    # labels column: every tag key a record site attaches (statically
+    # readable literal tuples) must be declared for that metric — an
+    # undeclared label is invisible cardinality no dashboard knows about
+    doc_labels = readme_registry_labels(os.path.join(root, "README.md"))
+    used_tags = collect_used_tag_keys(os.path.join(root, "ray_tpu"),
+                                      files)
+    for name, keys in sorted(used_tags.items()):
+        declared = doc_labels.get(name)
+        for key, where in sorted(keys.items()):
+            if not _TAG_KEY_RE.match(key):
+                problems.append(
+                    f"{name} ({where}): tag key {key!r} violates the "
+                    "lower_snake label naming convention")
+            if declared is not None and key not in declared:
+                problems.append(
+                    f"{name} ({where}): records tag {key!r} but the "
+                    "README registry row does not declare that label")
     problems += check_events(root, files)
     return problems
 

@@ -205,6 +205,258 @@ def test_group_validation_errors(rtpu_init):
         col.create_collective_group(members, 2, [0, 2])
 
 
+def _make_ring_worker():
+    """Members for the peer-to-peer data-plane tests: deterministic
+    per-rank payloads generated in-actor (hashes travel back, not
+    8 MB arrays), plus wire-traffic introspection."""
+    import hashlib
+
+    import ray_tpu
+    from ray_tpu._private import coll_transport
+    from ray_tpu.comm import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class Ring(col.CollectiveActorMixin):
+        def big_allreduce(self, n, op, timeout=None):
+            rank = col.get_rank()
+            x = ((np.arange(n) % 13) + 1 + rank).astype(np.float32)
+            out = col.allreduce(x, op=op, timeout=timeout)
+            return (hashlib.sha256(out.tobytes()).hexdigest(),
+                    out.dtype.str, out.shape)
+
+        def wire_delta_allreduce(self, n):
+            before = coll_transport.stats()["sent_bytes"]
+            x = np.ones(n, np.float32)
+            col.allreduce(x)
+            return coll_transport.stats()["sent_bytes"] - before
+
+        def uses_p2p(self):
+            from ray_tpu.comm.collective import _groups
+            return _groups()["default"].use_p2p
+
+        def ar_group(self, n, group):
+            x = np.full(n, float(col.get_rank(group) + 1), np.float32)
+            return col.allreduce(x, group_name=group)
+
+    return Ring
+
+
+def _expected_hash(n, world, op):
+    import functools
+    import hashlib
+
+    from ray_tpu.comm.collective import _BINARY
+    parts = [((np.arange(n) % 13) + 1 + rank).astype(np.float32)
+             for rank in range(world)]
+    out = functools.reduce(_BINARY[op], parts)
+    return hashlib.sha256(out.tobytes()).hexdigest()
+
+
+def test_large_allreduce_bitexact_all_ops(rtpu_init):
+    """>=8 MB ring allreduce (reduce-scatter + allgather, multiple
+    pipelined chunks per segment) must be bit-exact vs numpy for every
+    op variant on every rank. Values are small integers, so any
+    reduction order is exact in float32 — a mismatch means bytes were
+    corrupted or misrouted, not rounding."""
+    from ray_tpu.comm import collective as col
+    Ring = _make_ring_worker()
+    world = 4
+    n = 2_097_152                      # 8 MB of float32
+    members = [Ring.remote() for _ in range(world)]
+    col.create_collective_group(members, world, list(range(world)))
+    assert all(ray_tpu.get([m.uses_p2p.remote() for m in members]))
+    for op in (col.SUM, col.PROD, col.MIN, col.MAX):
+        outs = ray_tpu.get([m.big_allreduce.remote(n, op)
+                            for m in members], timeout=120)
+        want = _expected_hash(n, world, op)
+        for digest, dtype, shape in outs:
+            assert digest == want, f"op={op}: result bytes differ"
+            assert np.dtype(dtype) == np.float32
+            assert tuple(shape) == (n,)
+
+
+def test_ring_wire_traffic_is_o_size(rtpu_init):
+    """Per-rank wire traffic of a ring allreduce is ~2*(w-1)/w of the
+    tensor size — O(size), independent of world size — instead of the
+    seed's O(world*size) through one coordinator process."""
+    from ray_tpu.comm import collective as col
+    Ring = _make_ring_worker()
+    world = 4
+    n = 2_097_152                      # 8 MB of float32
+    size = n * 4
+    members = [Ring.remote() for _ in range(world)]
+    col.create_collective_group(members, world, list(range(world)))
+    deltas = ray_tpu.get([m.wire_delta_allreduce.remote(n)
+                          for m in members], timeout=120)
+    ideal = 2 * (world - 1) * size // world     # 12 MB at w=4
+    for sent in deltas:
+        assert ideal * 0.95 <= sent <= ideal * 1.2, (
+            f"rank sent {sent} bytes; ring schedule should send ~{ideal}")
+
+
+def test_rank_death_surfaces_timeout_everywhere(rtpu_init):
+    """A rank dying mid-collective must surface a timeout on every
+    survivor instead of hanging them (the deadline is the failure
+    detector on the fire-and-forget chunk plane)."""
+    from ray_tpu.comm import collective as col
+    Ring = _make_ring_worker()
+    members = [Ring.remote() for _ in range(3)]
+    col.create_collective_group(members, 3, [0, 1, 2])
+    ray_tpu.kill(members[2])
+    refs = [m.big_allreduce.remote(500_000, col.SUM, 4.0)
+            for m in members[:2]]
+    for ref in refs:
+        try:
+            ray_tpu.get(ref, timeout=60)
+            raise AssertionError("survivor completed against a dead rank")
+        except Exception as exc:                 # noqa: BLE001
+            assert "timed out" in str(exc).lower(), exc
+
+
+def test_driver_as_rank(rtpu_init):
+    """The driver process is a first-class rank: its endpoint registers
+    on the node like any worker's, and chunks deposited by its reader
+    thread wake the main thread blocked in the ring step."""
+    from ray_tpu.comm import collective as col
+    Ring = _make_ring_worker()
+    m = Ring.remote()
+    n = 300_000                        # 1.2 MB -> ring path
+    # the actor joins rank 1 concurrently (it blocks until the driver's
+    # rank-0 init creates the coordinator), and its allreduce must be
+    # in flight before the driver's own call blocks this thread
+    join_ref = m._rtpu_init_collective.remote(2, 1, "drv")
+    col.init_collective_group(2, 0, group_name="drv")
+    ray_tpu.get(join_ref)
+    ar_ref = m.ar_group.remote(n, "drv")
+    out = col.allreduce(np.full(n, 1.0, np.float32), group_name="drv")
+    np.testing.assert_array_equal(out, np.full(n, 3.0, np.float32))
+    np.testing.assert_array_equal(ray_tpu.get(ar_ref), out)
+    col.destroy_collective_group("drv")
+
+
+def test_coordinator_ttl_sweep():
+    """Satellite regression: a rank that times out of a fallback
+    rendezvous (or an un-taken mailbox post) must not leak its call
+    record forever — records older than the TTL are swept."""
+    import asyncio
+
+    from ray_tpu.comm.collective import _CoordinatorImpl
+
+    async def run():
+        c = _CoordinatorImpl(2, ttl_s=0.05)
+        status, detail = await c.rendezvous(("g", "e", 0), 0,
+                                            np.ones(4), "sum", 0.01)
+        assert status == "timeout" and "1/2" in detail
+        await c.post(1, (0, 0, 0), np.ones(1))
+        assert c.debug_counts() == {"calls": 1, "mail": 1}
+        await asyncio.sleep(0.12)
+        assert c.debug_counts() == {"calls": 0, "mail": 0}
+        # a post-sweep straggler gets a timeout, not a stale result
+        status, _ = await c.rendezvous(("g", "e", 0), 1,
+                                       np.ones(4), "sum", 0.01)
+        assert status == "timeout"
+
+    asyncio.run(run())
+
+
+def test_fallback_star_path(rtpu_init):
+    """collective_p2p_enabled=0 degrades to the coordinator data path:
+    results stay correct (streaming pairwise accumulation), dtypes are
+    preserved, and completed calls leave no records behind (the old
+    busy-poll rendezvous is gone — callers block on coordinator-side
+    asyncio events)."""
+    import ray_tpu
+    from ray_tpu.comm import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class Fb(col.CollectiveActorMixin):
+        def disable_p2p(self):
+            from ray_tpu._private.config import CONFIG
+            CONFIG._values["collective_p2p_enabled"] = False
+            return True
+
+        def ar(self, x, op):
+            return col.allreduce(np.asarray(x), op=op)
+
+        def gather(self, x):
+            return col.allgather(np.asarray(x))
+
+        def sendrecv(self):
+            rank = col.get_rank()
+            if rank == 0:
+                col.send(np.arange(3, dtype=np.int32), dst_rank=1)
+                return None
+            return col.recv(src_rank=0)
+
+        def uses_p2p(self):
+            from ray_tpu.comm.collective import _groups
+            return _groups()["default"].use_p2p
+
+    members = [Fb.remote() for _ in range(3)]
+    ray_tpu.get([m.disable_p2p.remote() for m in members])
+    col.create_collective_group(members, 3, [0, 1, 2])
+    assert not any(ray_tpu.get([m.uses_p2p.remote() for m in members]))
+
+    outs = ray_tpu.get([m.ar.remote(np.full(5, i + 1, np.int32), col.SUM)
+                        for i, m in enumerate(members)])
+    for arr in outs:
+        assert arr.dtype == np.int32
+        np.testing.assert_array_equal(arr, np.full(5, 6, np.int32))
+
+    gathered = ray_tpu.get([m.gather.remote([float(i)])
+                            for i, m in enumerate(members)])
+    for parts in gathered:
+        np.testing.assert_allclose(np.concatenate(parts), [0.0, 1.0, 2.0])
+
+    sr = ray_tpu.get([m.sendrecv.remote() for m in members[:2]])
+    np.testing.assert_array_equal(sr[1], np.arange(3, dtype=np.int32))
+
+    # every call completed and was acked by all ranks: nothing may leak
+    coord = ray_tpu.get_actor("rtpu:collective:default")
+    counts = ray_tpu.get(coord.debug_counts.remote())
+    assert counts == {"calls": 0, "mail": 0}
+
+
+def test_mesh_group_collective(rtpu_init):
+    """MeshGroup(collective_group=...) wires the host gang into a
+    host-level collective group: the mesh_* helpers ride the p2p data
+    plane."""
+    @ray_tpu.remote(num_cpus=1)
+    class HostC(SPMDWorkerBase):
+        def sync(self, n):
+            x = np.full(n, float(self.mesh_rank + 1), np.float32)
+            out = self.mesh_allreduce(x)
+            self.mesh_barrier()
+            return float(out[0]), int(out.shape[0])
+
+    group = mesh_group(HostC, num_hosts=2,
+                       resources_per_host={"CPU": 1},
+                       strategy="PACK", collective_group="meshg")
+    assert group.run("sync", 50_000) == [(3.0, 50_000)] * 2
+    group.shutdown()
+
+
+def test_group_init_on_saturated_cluster(rtpu_init):
+    """Members holding EVERY cluster CPU can still form a group. The
+    coordinator is a num_cpus=0 actor, and an explicit 0 must skip the
+    implicit 1-CPU creation charge (resources survive as {"CPU": 0.0});
+    meanwhile the ranks blocked in init free their worker-pool slots
+    (blocked_gets). Regression: this deadlocked — every rank waited on
+    a coordinator that could neither schedule nor spawn."""
+    from ray_tpu.comm import collective as col
+
+    @ray_tpu.remote(num_cpus=1)
+    class Busy(col.CollectiveActorMixin):
+        def ar(self, x):
+            return col.allreduce(np.asarray(x, np.float32))
+
+    members = [Busy.remote() for _ in range(4)]   # 4 CPUs: all of them
+    col.create_collective_group(members, 4, [0, 1, 2, 3])
+    outs = ray_tpu.get([m.ar.remote([1.0]) for m in members], timeout=60)
+    for arr in outs:
+        np.testing.assert_allclose(arr, [4.0])
+
+
 def test_destroy_and_recreate_group(rtpu_init):
     from ray_tpu.comm import collective as col
     Full = _make_full_worker()
