@@ -68,10 +68,13 @@ class CoreClient:
         # run at ANY point — including while this thread already holds
         # _ref_lock — so decrements only append to a lock-free deque and
         # are applied under the lock by ref_incr or the flusher thread.
-        # Edges are sent INSIDE the lock: a register and a drop can never
-        # reach the wire in inverted order.
+        # Edge order is captured by the shared buffer and batches leave
+        # FIFO under _edge_flush_lock, so a register and a drop can
+        # never reach the wire in inverted order (the socket write
+        # itself stays OUT of _ref_lock — see flush_refs).
         self._ref_counts: Dict[ObjectID, int] = {}
         self._ref_lock = threading.Lock()
+        self._edge_flush_lock = threading.Lock()
         self._pending_decrs: "deque[ObjectID]" = deque()
         # ordered edge stream, coalesced into one REF_BATCH frame — one
         # socket write per ~batch of submissions instead of one per ref.
@@ -95,14 +98,16 @@ class CoreClient:
 
     # ------------------------------------------------------------ refcounts
     def ref_incr(self, oid: ObjectID) -> None:
+        flush = False
         with self._ref_lock:
             self._apply_decrs_locked()
             n = self._ref_counts.get(oid, 0)
             self._ref_counts[oid] = n + 1
             if n == 0:
                 self._edge_buf.append((P.REF_REGISTER, oid))
-            if len(self._edge_buf) >= 256:
-                self._flush_edges_locked()
+            flush = len(self._edge_buf) >= 256
+        if flush:
+            self.flush_refs()
         self._ensure_flusher()
 
     def ref_decr(self, oid: ObjectID) -> None:
@@ -122,25 +127,31 @@ class CoreClient:
             else:
                 self._ref_counts[oid] = n
 
-    def _flush_edges_locked(self) -> None:
-        if not self._edge_buf or self._closed.is_set():
-            self._edge_buf.clear()
-            return
-        batch, self._edge_buf = self._edge_buf, []
-        try:
-            self._send(P.REF_BATCH, batch)
-        except OSError:
-            pass
-
     def flush_refs(self) -> None:
         """Synchronously emit buffered ref edges. Called at ordering
         boundaries: a worker flushes BEFORE sending TASK_DONE so borrows
         registered during execution land while the task's arg pins still
         hold; a driver flushes after get() so refs unpickled out of a
-        returned value are registered promptly."""
-        with self._ref_lock:
-            self._apply_decrs_locked()
-            self._flush_edges_locked()
+        returned value are registered promptly.
+
+        The socket write happens OUTSIDE ``_ref_lock`` (it used to be
+        inside, serializing every concurrent ``.remote()`` caller's
+        ref_incr behind a peer's flush — measured as the top non-wait
+        cost of n_n driver threads). Wire order is still exact: edge
+        ORDER lives in the shared buffer, and ``_edge_flush_lock`` —
+        held across take-and-send — keeps batches FIFO, so a register
+        and a drop can never reach the wire inverted."""
+        with self._edge_flush_lock:
+            with self._ref_lock:
+                self._apply_decrs_locked()
+                if not self._edge_buf or self._closed.is_set():
+                    self._edge_buf.clear()
+                    return
+                batch, self._edge_buf = self._edge_buf, []
+            try:
+                self._send(P.REF_BATCH, batch)
+            except OSError:
+                pass
 
     def _ensure_flusher(self) -> None:
         if self._flusher is not None and self._flusher.is_alive():
@@ -159,16 +170,12 @@ class CoreClient:
             except OSError:
                 pass
             if self._pending_decrs or self._edge_buf:
-                with self._ref_lock:
-                    self._apply_decrs_locked()
-                    self._flush_edges_locked()
+                self.flush_refs()
         try:
             self.flush_submissions()
         except OSError:
             pass
-        with self._ref_lock:
-            self._apply_decrs_locked()
-            self._flush_edges_locked()
+        self.flush_refs()
 
     def _active_namespace(self) -> str:
         """Task-context namespace if set (worker executing a task), else
@@ -346,7 +353,7 @@ class CoreClient:
         with self._sub_lock:
             self._sub_buf.append((op, payload))
             n = len(self._sub_buf)
-        if n >= 200:
+        if n >= CONFIG.submit_batch_max_specs:
             self.flush_submissions()
         else:
             self._ensure_flusher()

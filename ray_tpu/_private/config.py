@@ -31,13 +31,26 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                          "(native/object_arena.cpp) when the library builds; "
                          "falls back to per-object segments"),
     # --- scheduler ---
-    "worker_pipeline_depth": (int, 1,
-                              "EXPERIMENTAL: max tasks leased to one busy "
-                              "worker (running + queued) when more same-shape "
-                              "tasks are pending than idle workers. Default 1 "
-                              "(off): lease rescue for nested blocking tasks "
-                              "has known races under heavy contention "
-                              "(reference: worker-lease reuse)"),
+    "worker_pipeline_depth": (int, 4,
+                              "max tasks leased to one busy worker (running "
+                              "+ queued) when more same-shape tasks are "
+                              "pending than idle workers; grants/returns "
+                              "carry per-worker monotonic lease seqs so "
+                              "stale rescues are dropped (reference: "
+                              "worker-lease reuse, direct_task_transport.h)."
+                              " 1 disables pipelining"),
+    "dispatcher_event_batch": (int, 128,
+                               "max queued events the node dispatcher "
+                               "drains per loop turn; the batch is handled "
+                               "with one scheduling pass and one outbox "
+                               "flush (a burst of TASK_DONEs frees N "
+                               "workers, then dispatches once)"),
+    "submit_batch_max_specs": (int, 200,
+                               "client-side combining buffer: task/actor-"
+                               "call submissions coalesce into one "
+                               "SUBMIT_BATCH frame, flushed at this count "
+                               "or by the next blocking op / flusher "
+                               "cadence"),
     "scheduler_spread_threshold": (float, 0.5,
                                    "hybrid policy: pack below this node utilization, "
                                    "spread above (reference: scheduler_spread_threshold)"),

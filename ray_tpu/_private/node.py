@@ -60,6 +60,14 @@ class _Worker:
     # running task holds the resource charge; the charge transfers on
     # each completion since every piped task has the identical shape.
     pipeline: "deque" = field(default_factory=deque)
+    # monotonic per-worker lease grant counter: every EXECUTE pushed to
+    # this worker (assignment or pipelined lease) carries the next seq,
+    # and the worker echoes it on RETURN_LEASED — a rescue that names a
+    # superseded grant is provably stale and is dropped instead of
+    # un-assigning whatever the task's CURRENT grant is (the sequenced
+    # handshake that made pipelining default-on; reference analogue:
+    # lease ids in ``direct_task_transport.h``)
+    lease_seq: int = 0
     actor_id: Optional[ActorID] = None
     started_at: float = field(default_factory=time.monotonic)
     # when the current task/actor work was assigned — pooled workers are
@@ -127,6 +135,9 @@ class _TaskRecord:
     # blocking task): never pipe it again — one bounce max per task,
     # so rescue storms terminate and normal scheduling takes over
     no_pipe: bool = False
+    # seq of the grant currently dispatching this task (see
+    # _Worker.lease_seq); a RETURN_LEASED naming any other seq is stale
+    lease_seq: int = 0
 
 
 
@@ -462,6 +473,10 @@ class NodeService:
         # worker, not 100); flushed at the end of every dispatcher
         # event by _dispatch_loop
         self._exec_outbox: Dict[WorkerID, List[tuple]] = {}
+        # per-connection reply outbox (dispatcher-thread replies only):
+        # GET/WAIT replies coalesce across one event batch into one
+        # frame per client — see _reply_batched
+        self._reply_outbox: Dict[int, List[tuple]] = {}
         # True while draining a SUBMIT_BATCH: _queue_local defers its
         # per-spec _dispatch so the burst is one scheduling pass
         self._in_batch = False
@@ -1352,20 +1367,68 @@ class NodeService:
     def _dispatch_loop(self) -> None:
         while True:
             item = self._events.get()
-            if item[0] == "stop":
-                return
+            # Drain everything already queued: a burst of events (many
+            # TASK_DONEs, object seals, submissions from several conns)
+            # is handled with ONE scheduling pass and one outbox flush,
+            # not one per event — the cross-event extension of the
+            # SUBMIT_BATCH burst hook. Bounded so ticks/outbox flushes
+            # keep their cadence under sustained load.
+            batch: Optional[list] = None
+            budget = CONFIG.dispatcher_event_batch - 1
+            while budget > 0:
+                try:
+                    nxt = self._events.get_nowait()
+                except queue.Empty:
+                    break
+                if batch is None:
+                    batch = [item]
+                batch.append(nxt)
+                budget -= 1
+            if batch is None:
+                if item[0] == "stop":
+                    return
+                try:
+                    self._handle(item)
+                except Exception:
+                    import traceback
+                    traceback.print_exc(file=sys.stderr)
+                finally:
+                    self._flush_outboxes()
+                continue
+            stop = False
+            prev = self._in_batch
+            self._in_batch = True
             try:
-                self._handle(item)
-            except Exception:
-                import traceback
-                traceback.print_exc(file=sys.stderr)
+                for it in batch:
+                    if it[0] == "stop":
+                        stop = True
+                        break
+                    try:
+                        self._handle(it)
+                    except Exception:
+                        import traceback
+                        traceback.print_exc(file=sys.stderr)
             finally:
-                if self._exec_outbox:
-                    self._flush_exec_outbox()
+                self._in_batch = prev
+            if not stop:
+                try:
+                    self._dispatch()
+                except Exception:
+                    import traceback
+                    traceback.print_exc(file=sys.stderr)
+            self._flush_outboxes()
+            if stop:
+                return
 
     def _send_execute(self, w: _Worker, item: tuple) -> None:
         """Queue an EXECUTE for this worker; coalesced per event."""
         self._exec_outbox.setdefault(w.worker_id, []).append(item)
+
+    def _flush_outboxes(self) -> None:
+        if self._exec_outbox:
+            self._flush_exec_outbox()
+        if self._reply_outbox:
+            self._flush_reply_outbox()
 
     def _flush_exec_outbox(self) -> None:
         outbox, self._exec_outbox = self._exec_outbox, {}
@@ -1380,6 +1443,26 @@ class NodeService:
                     w.conn.send((P.EXECUTE_BATCH, items))
             except OSError:
                 self._events.put(("conn_closed", w.conn_key))
+
+    def _reply_batched(self, conn_key: int, op: int, payload: Any) -> None:
+        """Reply from a DISPATCHER-thread path: buffered per connection
+        and flushed as one ordered burst at the end of the current event
+        batch — a storm of GET_REPLYs costs the client one frame and one
+        reader wakeup instead of one each. Zero added latency: the flush
+        happens before the dispatcher sleeps again. Reader/debug threads
+        must keep using _reply (direct, thread-safe)."""
+        self._reply_outbox.setdefault(conn_key, []).append((op, payload))
+
+    def _flush_reply_outbox(self) -> None:
+        outbox, self._reply_outbox = self._reply_outbox, {}
+        for key, msgs in outbox.items():
+            conn = self._conns.get(key)
+            if conn is None:
+                continue
+            try:
+                conn.send_many(msgs)
+            except OSError:
+                pass
 
     # ------------------------------------------------------------- handling
     def _handle(self, item: tuple) -> None:
@@ -1470,7 +1553,8 @@ class NodeService:
                 self._env_spawn_failures.pop(w.env_key, None)
                 if w.state == "STARTING":
                     self._mark_idle(w)
-                self._dispatch()
+                if not self._in_batch:
+                    self._dispatch()
             else:
                 self._driver_conn_keys.add(key)
         elif op == P.SUBMIT_TASK:
@@ -1480,6 +1564,8 @@ class NodeService:
             # a 100-task burst is one scheduling pass, not 100.
             # Save/restore: this frame may itself arrive inside a
             # transport burst (_handle_burst) that defers the dispatch.
+            telemetry.hist_observe(telemetry.M_SUBMIT_BATCH,
+                                   float(len(payload)), self._mtags)
             prev = self._in_batch
             self._in_batch = True
             try:
@@ -1984,12 +2070,14 @@ class NodeService:
         for shape in self._pending.shapes():
             env_key = shape[2]
             bucket = self._pending.bucket(shape)
+            exhausted = False
             while bucket:
                 rec = bucket[0]
                 if rec.cancelled:
                     self._pending.popleft(shape)
                     continue
                 if not self._try_acquire(rec):
+                    exhausted = True
                     break                # this shape doesn't fit right now
                 if env_key in starved_envs:
                     # spawn already requested this pass for this env;
@@ -2029,7 +2117,16 @@ class NodeService:
                     break
                 self._pending.popleft(shape)
                 self._assign(rec, wid)
-            if bucket:
+            if bucket and (exhausted or self._num_starting == 0):
+                # lease extra tasks onto busy workers only when no new
+                # worker is coming: capacity is the binding constraint
+                # (exhausted), or the pool/startup cap blocked spawning
+                # (nothing STARTING even after the spawn attempts above
+                # — the num_cpus=0 burst regime). When workers are
+                # merely cold-starting, DON'T pipe: it would park a
+                # task behind a possibly-long running one (head-of-line
+                # blocking) when a spawning worker could serve it in
+                # milliseconds.
                 self._pipeline_into_busy(shape, bucket)
             self._pending.drop_empty(shape)
         # fresh budget for future submissions: the blacklist applies to
@@ -2057,7 +2154,7 @@ class NodeService:
                 break
             if (w.state != "BUSY" or w.conn is None or w.task is None
                     or w.task.kind != "task"
-                    or w.task.blocked_depth > 0
+                    or w.task.blocked_depth > 0 or w.blocked_gets
                     or getattr(w.task, "_pending_shape", None) != shape):
                 # never lease behind a task blocked in get(): the queue
                 # would park until it unblocks (and could BE what it
@@ -2078,11 +2175,13 @@ class NodeService:
                 self._record_event(rec.spec, "RUNNING")
                 self._pin_deps(rec)
                 rec.spec.accel_ids = None
+                w.lease_seq += 1
+                rec.lease_seq = w.lease_seq
                 w.pipeline.append(rec)
                 _pdbg(f"pipe {rec.spec.task_id.hex()[:8]} -> "
-                      f"{w.worker_id.hex()[:6]}")
+                      f"{w.worker_id.hex()[:6]} seq={rec.lease_seq}")
                 self._send_execute(w, (rec.kind, rec.spec, rec.deps,
-                                       rec.actor_spec))
+                                       rec.actor_spec, rec.lease_seq))
 
     def _spill_starved_pending(self) -> None:
         """Re-route queued tasks that have starved locally while another
@@ -2235,7 +2334,7 @@ class NodeService:
             # no CPU to return (actor method: the creation holds the
             # charge) — but the pool-cap exemption just changed, and a
             # parked actor creation may now have room to spawn into
-            if w.blocked_gets == 1:
+            if w.blocked_gets == 1 and not self._in_batch:
                 self._dispatch()
             return
         rec.blocked_depth += 1
@@ -2245,31 +2344,51 @@ class NodeService:
             pool = self._rec_charge_pool(rec)
             if pool is not None:
                 sched.add(pool, {"CPU": cpu})
-        self._dispatch()
+        if not self._in_batch:
+            self._dispatch()
 
-    def _on_return_leased(self, conn_key: int, task_ids: list) -> None:
+    def _on_return_leased(self, conn_key: int, entries: list) -> None:
         """A worker entering a blocking get() handed back its unstarted
         leased tasks (they could be the very children it waits on —
         nested submission would deadlock behind it). The WORKER drained
         its own queue, so it will never run these; requeueing them here
-        is double-execution-free by construction."""
+        is double-execution-free by construction.
+
+        Sequenced handshake: each entry is ``(task_id, lease_seq)``
+        echoing the seq the grant's EXECUTE carried. A return is
+        honored only when the seq matches the task's CURRENT grant on
+        THIS worker — a rescue delayed past a re-grant (the task was
+        already requeued and dispatched again, here or elsewhere) names
+        a superseded seq and is dropped instead of un-assigning the
+        live incarnation (the double-dispatch/strand race that kept
+        pipelining default-off)."""
         wid = self._conn_worker.get(conn_key)
         w = self._workers.get(wid) if wid is not None else None
         if w is None:
             return
         by_id = {r.spec.task_id: r for r in w.pipeline}
-        for tid in task_ids:
+        for tid, seq in entries:
             rec = by_id.get(tid)
-            _pdbg(f"return_leased {tid.hex()[:8]} from "
+            _pdbg(f"return_leased {tid.hex()[:8]} seq={seq} from "
                   f"{w.worker_id.hex()[:6]} found={rec is not None}")
+            if rec is not None and rec.lease_seq == seq:
+                w.pipeline.remove(rec)
+                self._running.pop(tid, None)
+                self._unpin_deps(rec)
+                rec.worker_id = None
+                rec.no_pipe = True
+                self._pending.append(rec)
+                continue
             if rec is None:
                 # handoff raced the bounce: a completion already
                 # promoted this lease to w.task (charge and all) while
                 # the worker was handing it back — un-assign it here or
                 # it stays "running" forever on a worker that never
-                # queued it
+                # queued it. Only for the SAME grant: a seq mismatch
+                # means w.task is a newer grant the worker did accept.
                 cur = w.task
-                if cur is not None and cur.spec.task_id == tid:
+                if (cur is not None and cur.spec.task_id == tid
+                        and cur.lease_seq == seq):
                     self._running.pop(tid, None)
                     self._unpin_deps(cur)
                     self._release_charge(cur)
@@ -2278,14 +2397,10 @@ class NodeService:
                     if w.state == "BUSY":
                         self._mark_idle(w)
                     self._pending.append(cur)
-                continue
-            w.pipeline.remove(rec)
-            self._running.pop(tid, None)
-            self._unpin_deps(rec)
-            rec.worker_id = None
-            rec.no_pipe = True
-            self._pending.append(rec)
-        self._dispatch()
+                    continue
+            _pdbg(f"stale rescue dropped {tid.hex()[:8]} seq={seq}")
+        if not self._in_batch:
+            self._dispatch()
 
     def _worker_unblocked(self, conn_key: int) -> None:
         wid = self._conn_worker.get(conn_key)
@@ -2296,6 +2411,11 @@ class NodeService:
             w.blocked_gets -= 1
         rec = w.task
         if rec is None or rec.charge is None or rec.blocked_depth == 0:
+            # an idle-but-was-blocked worker became leasable again:
+            # pending tasks skipped it while _acquire_worker held it out
+            if (w.state == "IDLE" and not w.blocked_gets
+                    and self._pending and not self._in_batch):
+                self._dispatch()
             return
         rec.blocked_depth -= 1
         if rec.blocked_depth > 0:
@@ -2309,6 +2429,11 @@ class NodeService:
                 # just waits for real capacity (same oversubscription
                 # the reference accepts on unblock)
                 sched.subtract(pool, {"CPU": cpu})
+        # the pipeliner skipped this worker while blocked_gets > 0;
+        # now that it is leasable again, pending same-shape tasks can
+        # pipe onto it without waiting for the next completion/tick
+        if not w.blocked_gets and self._pending and not self._in_batch:
+            self._dispatch()
 
     def _rec_env_key(self, rec: "_TaskRecord") -> str:
         from . import runtime_env as renv
@@ -2330,6 +2455,13 @@ class NodeService:
             wid = self._idle.popleft()
             w = self._workers.get(wid)
             if w is None or w.state != "IDLE":
+                continue
+            if w.blocked_gets:
+                # a thread of this worker is still parked in a blocking
+                # get(): a grant would only bounce straight back
+                # (reader-side rescue) and ping-pong until it unblocks —
+                # keep it queued, skip it for now
+                kept.append(wid)
                 continue
             if w.env_key == env_key:
                 found = wid
@@ -2582,10 +2714,12 @@ class NodeService:
         self._record_event(rec.spec, "RUNNING")
         self._pin_deps(rec)
         rec.spec.accel_ids = rec.accel_ids
+        w.lease_seq += 1
+        rec.lease_seq = w.lease_seq
         _pdbg(f"assign {rec.spec.task_id.hex()[:8]} ({rec.kind}) -> "
-              f"{w.worker_id.hex()[:6]}")
+              f"{w.worker_id.hex()[:6]} seq={rec.lease_seq}")
         self._send_execute(w, (rec.kind, rec.spec, rec.deps,
-                               rec.actor_spec))
+                               rec.actor_spec, rec.lease_seq))
 
     # ------------------------------------------------------------ completion
     def _task_done(self, conn_key: int, task_id, metas: List[ObjectMeta],
@@ -2615,8 +2749,19 @@ class NodeService:
         telemetry.counter_inc(
             telemetry.M_TASKS_FINISHED, 1.0,
             self._mtags + (("status", "ok" if error is None else "error"),))
-        self.gcs.publish("TASK_FINISHED", {"task_id": task_id,
-                                           "ok": error is None})
+        owned = self._owned.pop(task_id, None)
+        if owned is not None:
+            # we are the owner: settle inline on the dispatcher instead
+            # of a pubsub fan-out + one more queued event per completion
+            # (the only subscriber work is this owned-pop + arg unpin)
+            try:
+                self.gcs.unpin_task_args(task_id)
+            except Exception:
+                pass
+        else:
+            # remote owner: its node's subscriber settles it
+            self.gcs.publish("TASK_FINISHED", {"task_id": task_id,
+                                               "ok": error is None})
         w = self._workers.get(rec.worker_id) if rec.worker_id else None
         if rec.kind == "actor_create":
             self._actor_creation_done(rec, error)
@@ -2630,6 +2775,7 @@ class NodeService:
             _pdbg(f"handoff {w.worker_id.hex()[:6]}: "
                   f"{rec.spec.task_id.hex()[:8]} -> "
                   f"{nxt.spec.task_id.hex()[:8]}")
+            telemetry.counter_inc(telemetry.M_LEASE_REUSED, 1.0, self._mtags)
             nxt.charge, rec.charge = rec.charge, None
             w.task = nxt
             w.assigned_at = time.monotonic()
@@ -2836,7 +2982,8 @@ class NodeService:
                 continue
             waiter.remaining.discard(oid)
             self._maybe_fire_waiter(waiter_id, waiter)
-        self._dispatch()
+        if not self._in_batch:
+            self._dispatch()
 
     def _fail_returns(self, spec: P.TaskSpec, exc: Exception) -> None:
         err = to_bytes(exc)
@@ -3117,7 +3264,9 @@ class NodeService:
         self._record_event(rec.spec, "RUNNING")
         self._pin_deps(rec)
         rec.spec.accel_ids = st.get("accel_ids")
-        self._send_execute(w, ("actor_call", rec.spec, rec.deps, None))
+        # seq 0: actor calls are never leased/returned, but the EXECUTE
+        # tuple shape is uniform
+        self._send_execute(w, ("actor_call", rec.spec, rec.deps, None, 0))
 
     def _kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
         rec = self.gcs.get_actor(actor_id)
@@ -3396,7 +3545,8 @@ class NodeService:
                 args=(waiter, metas), daemon=True,
                 name="rtpu-wire-fetch").start()
             return
-        self._reply(waiter.conn_key, P.GET_REPLY, (waiter.req_id, metas))
+        self._reply_batched(waiter.conn_key, P.GET_REPLY,
+                            (waiter.req_id, metas))
 
     def _fire_get_fetch(self, waiter: _Waiter, metas) -> None:
         wire = [self._wire_meta(oid, meta)
@@ -3475,8 +3625,8 @@ class NodeService:
         ready = [oid for oid in waiter.object_ids
                  if oid not in waiter.remaining]
         pending = [oid for oid in waiter.object_ids if oid in waiter.remaining]
-        self._reply(waiter.conn_key, P.WAIT_REPLY,
-                    (waiter.req_id, ready, pending))
+        self._reply_batched(waiter.conn_key, P.WAIT_REPLY,
+                            (waiter.req_id, ready, pending))
 
     def _timeout_wait(self, waiter_id: int) -> None:
         waiter = self._wait_waiters.pop(waiter_id, None)
@@ -3571,7 +3721,8 @@ class NodeService:
                 self._fail_returns(rec.spec, exceptions.WorkerCrashedError(
                     f"worker died while running {rec.spec.name}"))
         w.pipeline.clear()
-        self._dispatch()
+        if not self._in_batch:
+            self._dispatch()
 
     def _on_node_event(self, payload) -> None:
         if payload.get("state") == "DEAD" and payload["node_id"] != self.node_id:

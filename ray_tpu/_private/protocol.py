@@ -118,7 +118,9 @@ EXECUTE_BATCH = 62      # node -> worker: [EXECUTE_TASK payload, ...]
 # early result is never withheld behind a slow batch successor —
 # transport-level write coalescing now batches them without withholding)
 CANCEL_QUEUED = 64      # node -> worker: task_id queued behind current
-RETURN_LEASED = 65      # worker -> node: [task_id] unstarted leased tasks
+RETURN_LEASED = 65      # worker -> node: [(task_id, lease_seq)] unstarted
+                        # leased tasks, each echoing its grant's seq so a
+                        # stale rescue can never un-assign a newer grant
 RETURN_REFS = 66        # worker -> node: (return_oid, [contained oids]) —
                         # refs pickled INSIDE a return; pinned until the
                         # return object is freed (sent before TASK_DONE)
@@ -159,7 +161,9 @@ COLL_DELIVER = 76       # node -> client push: (key, payload) — deposited
 BATCH = 73
 
 # service -> client
-EXECUTE_TASK = 40       # (TaskSpec, {ObjectID: ObjectMeta} resolved deps)
+EXECUTE_TASK = 40       # (kind, TaskSpec, resolved deps, ActorSpec|None,
+                        # lease_seq) — seq names this grant in the
+                        # sequenced lease handshake (0 for actor calls)
 GET_REPLY = 41          # (req_id, [ObjectMeta])
 WAIT_REPLY = 42         # (req_id, [ready ObjectID], [pending ObjectID])
 NAMED_ACTOR_REPLY = 43  # (req_id, actor_info | None)
@@ -494,6 +498,19 @@ class Connection:
         if msgs:
             self._enqueue(tuple(msgs))
             self._drain()
+
+    def send_lazy(self, msg: Tuple[int, Any]) -> None:
+        """Enqueue WITHOUT draining: the message leaves on the next
+        ``send``/``send_many``/``kick``/``flush`` (any of which drains
+        the whole queue in order). Lets a sender coalesce a frame with
+        ones it knows are coming — the caller owns bounding the hold
+        (e.g. the worker's TASK_DONE kicker)."""
+        self._enqueue((msg,))
+
+    def kick(self) -> None:
+        """Drain anything queued (no-op when empty): the flush half of
+        ``send_lazy``."""
+        self._drain()
 
     def _enqueue(self, msgs: tuple) -> None:
         with self._qlock:

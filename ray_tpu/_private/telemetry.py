@@ -370,6 +370,19 @@ M_QUEUE_WAIT = define(
 M_PENDING_TASKS = define(
     "gauge", "rtpu_scheduler_pending_tasks",
     "Tasks in the local ready-to-dispatch queue")
+M_LEASE_REUSED = define(
+    "counter", "rtpu_scheduler_lease_reused_total",
+    "Completions whose worker lease was handed straight to the next "
+    "pipelined task (no scheduler round trip)")
+M_PIPELINE_DEPTH = define(
+    "gauge", "rtpu_scheduler_pipeline_depth",
+    "Tasks currently leased onto busy workers beyond their running "
+    "task, summed over the node's workers (sampled)")
+M_SUBMIT_BATCH = define(
+    "histogram", "rtpu_scheduler_submit_batch_specs",
+    "Task/actor-call specs per coalesced SUBMIT_BATCH frame admitted "
+    "by the dispatcher as one scheduling pass",
+    buckets=(2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0))
 M_STORE_PUTS = define(
     "counter", "rtpu_object_store_puts_total",
     "Objects sealed into the local object store")
@@ -509,6 +522,10 @@ def sample_once() -> None:
         try:
             gauge_set(M_PENDING_TASKS, float(len(node._pending)), tags)
             gauge_set(M_NODE_WORKERS, float(len(node._workers)), tags)
+            gauge_set(M_PIPELINE_DEPTH,
+                      float(sum(len(w.pipeline)
+                                for w in list(node._workers.values()))),
+                      tags)
         except Exception:   # noqa: BLE001
             pass
         _sample_host(tags)

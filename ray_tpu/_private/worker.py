@@ -20,6 +20,7 @@ import os
 import signal
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from queue import Empty, SimpleQueue
@@ -61,6 +62,14 @@ class WorkerRuntime:
         # a glance (and the profiler's runtime-thread filter keeps it)
         self._exec_thread = threading.Thread(target=self._exec_loop,
                                              name="task-exec", daemon=True)
+        # TASK_DONE coalescing: a DONE sent while MORE tasks are queued
+        # is enqueued lazily (no inline drain) so back-to-back tiny-task
+        # completions pack into one frame — the symmetric half of the
+        # node's EXECUTE_BATCH. The kicker thread bounds withholding to
+        # ~1-2ms: a slow successor task can never sit on a predecessor's
+        # result (any direct send on the conn also flushes it earlier).
+        self._kick_ev = threading.Event()
+        self._kicker: Optional[threading.Thread] = None
         self._pool: Optional[ThreadPoolExecutor] = None
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._current_task_thread: Optional[int] = None
@@ -104,11 +113,13 @@ class WorkerRuntime:
         """Reader-side: a plain-task lease arriving while the exec
         thread is blocked in get() would park until it unblocks; hand
         it straight back instead (it never enters the queue, so it can
-        never also run here)."""
+        never also run here). The bounce echoes the grant's lease seq
+        so the node can match it to the exact grant (a bounce landing
+        after the grant was superseded is dropped as stale)."""
         if not self._blocked_in_get or payload[0] != "task" \
                 or self._actor_spec is not None:
             return False
-        self.conn.send((P.RETURN_LEASED, [payload[1].task_id]))
+        self.conn.send((P.RETURN_LEASED, [(payload[1].task_id, payload[4])]))
         return True
 
     def _on_unblock(self) -> None:
@@ -132,7 +143,7 @@ class WorkerRuntime:
             except Empty:
                 break
             if item[0] == "task":
-                returned.append(item[1].task_id)
+                returned.append((item[1].task_id, item[4]))
             else:           # not leaseable work; keep it queued
                 self._exec_queue.put(item)
                 break
@@ -140,12 +151,12 @@ class WorkerRuntime:
             self.conn.send((P.RETURN_LEASED, returned))
 
     def _enqueue_execute(self, payload) -> None:
-        kind, spec, deps, actor_spec = payload
+        kind, spec, deps = payload[0], payload[1], payload[2]
         if kind == "actor_call" and (
                 self._pool is not None or self._aio_loop is not None):
             self._dispatch_concurrent(spec, deps)
         else:
-            self._exec_queue.put((kind, spec, deps, actor_spec))
+            self._exec_queue.put(payload)
 
     def _on_sigint(self, signum, frame) -> None:
         """Cancellation: raise TaskCancelledError inside the task thread
@@ -168,7 +179,7 @@ class WorkerRuntime:
 
     def _exec_loop_inner(self) -> None:
         while True:
-            kind, spec, deps, actor_spec = self._exec_queue.get()
+            kind, spec, deps, actor_spec, _seq = self._exec_queue.get()
             if spec.task_id in self._cancelled_queued:
                 # skipped, not executed: report NO return metas — for a
                 # rescued lease the task re-runs elsewhere and owns
@@ -183,6 +194,27 @@ class WorkerRuntime:
                 self._run_one(kind, spec, deps, actor_spec)
             finally:
                 self._current_task_thread = None
+
+    def _ensure_kicker(self) -> None:
+        if self._kicker is None:
+            t = threading.Thread(target=self._kick_loop,
+                                 name="done-kicker", daemon=True)
+            self._kicker = t
+            t.start()
+        self._kick_ev.set()
+
+    def _kick_loop(self) -> None:
+        """Flush lazily-queued TASK_DONE frames ~1ms after the first one
+        was held — the upper bound on how long a completed task's result
+        can wait for batchmates."""
+        while True:
+            self._kick_ev.wait()
+            self._kick_ev.clear()
+            time.sleep(0.001)
+            try:
+                self.conn.kick()
+            except OSError:
+                return
 
     def _dispatch_concurrent(self, spec: P.TaskSpec, deps) -> None:
         if self._aio_loop is not None:
@@ -375,8 +407,15 @@ class WorkerRuntime:
         # still end its stream — gen_count=0 + the error — or consumers
         # parked on item 0 hang forever
         gen_count = 0 if spec.num_returns == -1 else None
-        self.conn.send((P.TASK_DONE,
-                        (spec.task_id, metas, err_bytes, kind, gen_count)))
+        done = (P.TASK_DONE,
+                (spec.task_id, metas, err_bytes, kind, gen_count))
+        if kind != "actor_create" and not self._exec_queue.empty():
+            # more work is already queued: coalesce this DONE with the
+            # next completions (kicker bounds the hold to ~1-2ms)
+            self.conn.send_lazy(done)
+            self._ensure_kicker()
+        else:
+            self.conn.send(done)
         # unconditional: force-traced spans exist even when THIS node's
         # config has tracing off (flush is a no-op on an empty buffer)
         from ..util import tracing

@@ -86,7 +86,11 @@ def with_logical_constraint(x: jax.Array,
     spec = rules.spec(*logical_axes)
     if mesh is not None:
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
-    abstract = jax.sharding.get_abstract_mesh()
+    # jax < 0.5 has no get_abstract_mesh; without it (and without an
+    # explicit mesh) there is no way to name an implicit mesh — no-op,
+    # matching the "no mesh is active" contract
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    abstract = get_abstract() if get_abstract is not None else None
     if abstract is None or not abstract.axis_names:
         return x
     # Drop references to axes the active mesh doesn't carry.

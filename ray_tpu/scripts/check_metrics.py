@@ -78,16 +78,22 @@ _LABEL_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
 _TAG_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 
-def readme_registry_types(readme_path: str) -> Dict[str, str]:
-    """Metric name -> declared type (counter/gauge/histogram) from the
-    README's "Runtime metric registry" table rows. Empty when the
+def readme_registry_rows(readme_path: str) -> List[Tuple[str, str]]:
+    """Every (metric, declared type) registry-table row IN ORDER,
+    duplicates included — two rows for one metric would silently shadow
+    each other in the dict-shaped type/label views. Empty when the
     README has no such table (the name-presence check still applies)."""
     try:
         with open(readme_path) as f:
             text = f.read()
     except OSError:
-        return {}
-    return dict(_REGISTRY_ROW_RE.findall(text))
+        return []
+    return _REGISTRY_ROW_RE.findall(text)
+
+
+def readme_registry_types(readme_path: str) -> Dict[str, str]:
+    """Metric name -> declared type (counter/gauge/histogram)."""
+    return dict(readme_registry_rows(readme_path))
 
 
 def collect_defined_metric_kinds(pkg_dir: str,
@@ -115,6 +121,9 @@ def collect_defined_metric_kinds(pkg_dir: str,
     return out
 
 
+_ANY_LABEL_TOKEN_RE = re.compile(r"`([^`]+)`")
+
+
 def readme_registry_labels(readme_path: str) -> Dict[str, Set[str]]:
     """Metric name -> documented label set from the registry table's
     labels column (``—`` rows map to the empty set)."""
@@ -125,6 +134,18 @@ def readme_registry_labels(readme_path: str) -> Dict[str, Set[str]]:
         return {}
     return {name: set(_LABEL_NAME_RE.findall(cell))
             for name, cell in _REGISTRY_LABEL_ROW_RE.findall(text)}
+
+
+def readme_registry_label_cells(readme_path: str) -> List[Tuple[str, str]]:
+    """(metric name, RAW labels-column cell) per registry row — for the
+    label-naming lint, which must see malformed tokens that the
+    well-formed-only ``_LABEL_NAME_RE`` extraction would drop."""
+    try:
+        with open(readme_path) as f:
+            text = f.read()
+    except OSError:
+        return []
+    return _REGISTRY_LABEL_ROW_RE.findall(text)
 
 
 def collect_used_tag_keys(pkg_dir: str,
@@ -302,17 +323,38 @@ def check(repo_root: str = None) -> List[str]:
     # (a histogram documented as a counter misleads every dashboard)
     kinds = collect_defined_metric_kinds(os.path.join(root, "ray_tpu"),
                                          files)
-    row_types = readme_registry_types(os.path.join(root, "README.md"))
+    rows = readme_registry_rows(os.path.join(root, "README.md"))
+    row_types = dict(rows)
     for name, (kind, where) in sorted(kinds.items()):
         doc_type = row_types.get(name)
         if doc_type is not None and doc_type != kind:
             problems.append(
                 f"{name} ({where}): defined as {kind} but the README "
                 f"registry row says {doc_type}")
+    # duplicate registry rows: the dict-shaped views keep only the LAST
+    # row per metric, so a duplicate would silently make the type/label
+    # lints judge against the wrong declaration
+    seen_rows: Set[str] = set()
+    for name, _type in rows:
+        if name in seen_rows:
+            problems.append(
+                f"{name}: appears in more than one README registry row")
+        seen_rows.add(name)
     # labels column: every tag key a record site attaches (statically
     # readable literal tuples) must be declared for that metric — an
     # undeclared label is invisible cardinality no dashboard knows about
     doc_labels = readme_registry_labels(os.path.join(root, "README.md"))
+    # naming lint over the RAW label cells: the doc_labels extraction
+    # above only keeps well-formed tokens, so a malformed declared
+    # label (`node-id`, `nodeID`) would silently vanish from it
+    for name, cell in readme_registry_label_cells(
+            os.path.join(root, "README.md")):
+        for tok in _ANY_LABEL_TOKEN_RE.findall(cell):
+            if not _TAG_KEY_RE.match(tok):
+                problems.append(
+                    f"{name}: README registry declares label {tok!r}, "
+                    "which violates the lower_snake label naming "
+                    "convention")
     used_tags = collect_used_tag_keys(os.path.join(root, "ray_tpu"),
                                       files)
     for name, keys in sorted(used_tags.items()):
