@@ -263,6 +263,30 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     _ctx.require_client().kill_actor(actor.actor_id, no_restart)
 
 
+def exit_actor() -> None:
+    """Intentionally terminate the CURRENT actor from inside one of its
+    methods (reference: ``ray.actor.exit_actor``): the node kills this
+    actor's worker with restarts suppressed, and the executing method
+    unwinds — its caller observes the actor's death rather than a
+    return value."""
+    aid = _ctx.current_actor_id
+    if aid is None:
+        raise RuntimeError(
+            "exit_actor() can only be called inside an actor method")
+    client = _ctx.require_client()
+    try:
+        client.actor_exit(aid, "exit_actor()")
+    except OSError:
+        # the node never hears the intent, so restart suppression is
+        # lost — the conn-death path will treat this as a crash (and
+        # may restart the actor); say so instead of silently diverging
+        import sys as _sys
+        print(f"[ray_tpu] exit_actor(): ACTOR_EXIT send failed for "
+              f"{aid.hex()[:12]} (connection down); the actor may be "
+              "restarted as a crash", file=_sys.stderr)
+    raise SystemExit(0)
+
+
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     """Cancel the task that produces ``ref`` (reference: ``ray.cancel``)."""
     _ctx.require_client().cancel_task(ref.task_id(), force)

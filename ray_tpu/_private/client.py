@@ -18,6 +18,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions
+from . import locksan
 from . import protocol as P
 from .config import CONFIG
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
@@ -56,7 +57,7 @@ class CoreClient:
         self.on_worker_unblock = None
         self.reader = ObjectReader()
         self._futures: Dict[int, Future] = {}
-        self._req_lock = threading.Lock()
+        self._req_lock = locksan.lock("client.req")
         self._next_req = 1
         conn.on_send_error = self._on_send_error
         self._registered_fns: set = set()
@@ -73,8 +74,8 @@ class CoreClient:
         # never reach the wire in inverted order (the socket write
         # itself stays OUT of _ref_lock — see flush_refs).
         self._ref_counts: Dict[ObjectID, int] = {}
-        self._ref_lock = threading.Lock()
-        self._edge_flush_lock = threading.Lock()
+        self._ref_lock = locksan.lock("client.ref")
+        self._edge_flush_lock = locksan.lock("client.edge_flush")
         self._pending_decrs: "deque[ObjectID]" = deque()
         # ordered edge stream, coalesced into one REF_BATCH frame — one
         # socket write per ~batch of submissions instead of one per ref.
@@ -89,12 +90,12 @@ class CoreClient:
         # C++ submit queue). Flushed before ANY other frame leaves this
         # client, so cross-op ordering is exactly the unbatched order.
         self._sub_buf: List[Tuple[int, Any]] = []
-        self._sub_lock = threading.Lock()
+        self._sub_lock = locksan.lock("client.sub")
         # streaming-generator producer credit: {task_id: [consumed, Event]}
         # updated by GEN_ACK pushes; the executing thread waits on the
         # Event when its in-flight window fills
         self._gen_credit: Dict[TaskID, list] = {}
-        self._gen_credit_lock = threading.Lock()
+        self._gen_credit_lock = locksan.lock("client.gen_credit")
 
     # ------------------------------------------------------------ refcounts
     def ref_incr(self, oid: ObjectID) -> None:
@@ -149,7 +150,7 @@ class CoreClient:
                     return
                 batch, self._edge_buf = self._edge_buf, []
             try:
-                self._send(P.REF_BATCH, batch)
+                self._send(P.REF_BATCH, batch)  # lint: allow-under-lock(edge_flush exists to serialize take-and-send; FIFO wire order is the invariant)
             except OSError:
                 pass
 
@@ -404,9 +405,9 @@ class CoreClient:
                 return
             batch, self._sub_buf = self._sub_buf, []
             if len(batch) == 1:
-                self.conn.send(batch[0])
+                self.conn.send(batch[0])  # lint: allow-under-lock(a later submission must not reach the socket before this batch; actor per-submitter order rides frame order)
             else:
-                self.conn.send((P.SUBMIT_BATCH, batch))
+                self.conn.send((P.SUBMIT_BATCH, batch))  # lint: allow-under-lock(same FIFO invariant as the single-spec branch)
 
     # ------------------------------------------------------------- objects
     def put(self, value: Any) -> ObjectRef:
@@ -806,6 +807,11 @@ class CoreClient:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
         self._send(P.KILL_ACTOR, (actor_id, no_restart))
+
+    def actor_exit(self, actor_id: ActorID, reason: str) -> None:
+        """Worker-side intentional exit of its own actor (the send half
+        of ``ray_tpu.exit_actor()``)."""
+        self._send(P.ACTOR_EXIT, (actor_id, reason))
 
     def cancel_task(self, task_id: TaskID, force: bool) -> None:
         self._send(P.CANCEL_TASK, (task_id, force))

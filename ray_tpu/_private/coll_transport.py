@@ -18,11 +18,11 @@ or its deadline passes.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
 from . import context
+from . import locksan
 from . import telemetry
 from .config import CONFIG
 
@@ -38,8 +38,8 @@ M_COLL_INFLIGHT = telemetry.define(
     "Collective chunks delivered to this process but not yet consumed "
     "by a waiting rank thread")
 
-_lock = threading.Lock()
-_cond = threading.Condition(_lock)
+_lock = locksan.lock("coll.mailbox")
+_cond = locksan.condition("coll.mailbox", _lock)
 _slots: Dict[tuple, Any] = {}
 # arrival time per undelivered chunk, for the stale sweep: a rank that
 # timed out (or died) mid-collective leaves chunks addressed to keys no

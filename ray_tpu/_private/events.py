@@ -14,9 +14,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Any, Optional
+
+from . import locksan
 
 SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
 
@@ -29,7 +30,7 @@ class EventLogger:
                                   f"events_{node_id_hex[:12]}.jsonl")
         self._node = node_id_hex
         self._gcs = gcs
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("events.file")
 
     def emit(self, severity: str, label: str, message: str,
              local_only: bool = False, **fields: Any) -> None:

@@ -13,12 +13,12 @@ deployment puts this behind the same framed-socket RPC used everywhere else.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import locksan
 from .config import CONFIG
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from .object_store import ObjectMeta
@@ -181,7 +181,7 @@ class GlobalControlPlane:
         from . import gcs_storage
         self._storage = _CompactingStorage(
             storage or gcs_storage.InMemoryStorage(), self)
-        self._lock = threading.RLock()
+        self._lock = locksan.rlock("gcs.plane")
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorRecord] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}

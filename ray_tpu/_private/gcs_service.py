@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from . import locksan
 from . import protocol as P
 from . import serialization as ser
 from .config import CONFIG
@@ -60,7 +61,7 @@ class GcsServer:
         self._conn_node: Dict[int, NodeID] = {}      # node conns, for death
         self._subs: Dict[str, set] = {}              # channel -> conn keys
         self._hooked: set = set()                    # channels with fanout
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("gcs_server.conns")
         self._next_key = 1
         self._stopped = threading.Event()
         for t in (self._accept_loop, self._sweep_loop):
@@ -212,7 +213,7 @@ class RemoteControlPlane:
         host, port = address.rsplit(":", 1)
         self._conn = P.connect_tcp(host, int(port))
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
-        self._sub_lock = threading.Lock()
+        self._sub_lock = locksan.lock("gcs_client.subs")
         self._rpc = RpcChannel(self._conn, on_push=self._on_push)
         self._nodes_cache: Optional[List[NodeInfo]] = None
         self._nodes_cache_at = 0.0

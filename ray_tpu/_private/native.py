@@ -11,8 +11,9 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
+
+from . import locksan
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -21,7 +22,7 @@ _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "_native", "libobject_arena.so")
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = locksan.lock("native.lib")
 _build_failed = False
 
 
@@ -44,7 +45,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 with open(lock_path, "w") as lock_f:
                     fcntl.flock(lock_f, fcntl.LOCK_EX)
                     if not os.path.exists(_LIB_PATH):
-                        subprocess.run(["make", "-s"], cwd=_NATIVE_DIR,
+                        subprocess.run(["make", "-s"], cwd=_NATIVE_DIR,  # lint: allow-under-lock(one-time build; the lock is what makes exactly one thread run make)
                                        check=True, capture_output=True,
                                        timeout=120)
             except Exception:
@@ -129,7 +130,7 @@ class ArenaReader:
     """Reader-side attachment (one mmap per process per arena)."""
 
     _cache: dict = {}
-    _cache_lock = threading.Lock()
+    _cache_lock = locksan.lock("native.arena_cache")
 
     def __init__(self, path: str):
         lib = _load()

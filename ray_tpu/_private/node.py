@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions
 from . import events
+from . import locksan
 from . import memory_monitor
 from . import protocol as P
 from . import scheduler as sched
@@ -425,7 +426,7 @@ class NodeService:
         self.store = ObjectStore(
             spill_dir=os.path.join(session_dir, "spill", self.node_id.hex()[:12]))
 
-        self._res_lock = threading.Lock()
+        self._res_lock = locksan.lock("node.res")
         self.resources_total = dict(resources)
         self.resources_available = dict(resources)
         self.pg_reservations: Dict[tuple, Dict[str, float]] = {}
@@ -574,7 +575,7 @@ class NodeService:
         # Future resolved by STACK_REPLY/PROFILE_REPORT on the replying
         # connection's reader thread — never the dispatcher, so a stack
         # request cannot deadlock against task handling
-        self._debug_lock = threading.Lock()
+        self._debug_lock = locksan.lock("node.debug")
         self._debug_futures: Dict[int, Future] = {}
         self._next_debug_token = 1
 
@@ -669,7 +670,8 @@ class NodeService:
                          resources=dict(self.resources_total),
                          address=self.tcp_address or self.socket_path)
 
-    def stop(self, kill_workers: bool = True) -> None:
+    def stop(self, kill_workers: bool = True,
+             graceful: bool = True) -> None:
         if self._stopped.is_set():
             return
         self._stopped.set()
@@ -693,8 +695,32 @@ class NodeService:
         for peer in list(self._peers.values()):
             peer.close()
         self._peers.clear()
+        if graceful:
+            # graceful-death announcement: workers drain queued
+            # outbound frames (a TASK_DONE sitting in the writer queue)
+            # and exit; drivers fail pending futures with "node
+            # shutting down" instead of a bare connection-reset.
+            # Skipped on the kill() chaos path, which must look like a
+            # crash (reader EOF / heartbeat timeout), not a farewell.
+            for conn in list(self._conns.values()):
+                try:
+                    conn.send((P.SHUTDOWN, ()))
+                except OSError:
+                    pass
         self._events.put(("stop",))
         if kill_workers:
+            if graceful:
+                # give workers a beat to act on the SHUTDOWN frame
+                # (drain queued TASK_DONEs, close, exit) before the
+                # SIGKILL below reaps stragglers — responsive workers
+                # exit in single-digit ms, so this usually costs one
+                # poll; the cap bounds a wedged worker's hold
+                deadline = time.monotonic() + 0.25
+                procs = [w.proc for w in self._workers.values()
+                         if w.proc is not None]
+                while (time.monotonic() < deadline
+                       and any(p.poll() is None for p in procs)):
+                    time.sleep(0.01)
             for w in list(self._workers.values()):
                 if w.proc is not None:
                     try:
@@ -715,7 +741,7 @@ class NodeService:
 
     def kill(self) -> None:
         """Simulate abrupt node failure (for chaos tests)."""
-        self.stop(kill_workers=True)
+        self.stop(kill_workers=True, graceful=False)
 
     # ------------------------------------------------------ cross-thread API
     def available_snapshot(self) -> Dict[str, float]:
@@ -1095,7 +1121,7 @@ class NodeService:
                                         lambda w=what: self.node_stats(w))
             else:
                 self._reply(key, P.INFO_REPLY,
-                            (req_id, self.node_stats(what)))
+                            (req_id, self.node_stats(what)))  # lint: allow-on-reader(non-tuple whats are pure snapshots; the blocking tuple forms take the _spawn_debug_reply thread above)
         elif op in (P.STACK_REPLY, P.PROFILE_REPORT):
             token, data = payload
             with self._debug_lock:
@@ -1166,7 +1192,7 @@ class NodeService:
                                  else peer.dead):
             peer = None
         if peer is None:
-            peer = self._peer(NodeID(dst_node))
+            peer = self._peer(NodeID(dst_node))  # lint: allow-on-reader(one gcs.get_node RPC per peer-lifetime cache miss; steady-state chunks hit _coll_peers — PR5's documented tradeoff)
             if peer is None:
                 return
             self._coll_peers[dst_node] = peer
@@ -1424,6 +1450,7 @@ class NodeService:
         """Queue an EXECUTE for this worker; coalesced per event."""
         self._exec_outbox.setdefault(w.worker_id, []).append(item)
 
+    # concurrency: dispatcher-only
     def _flush_outboxes(self) -> None:
         if self._exec_outbox:
             self._flush_exec_outbox()
@@ -1444,6 +1471,7 @@ class NodeService:
             except OSError:
                 self._events.put(("conn_closed", w.conn_key))
 
+    # concurrency: dispatcher-only
     def _reply_batched(self, conn_key: int, op: int, payload: Any) -> None:
         """Reply from a DISPATCHER-thread path: buffered per connection
         and flushed as one ordered burst at the end of the current event
@@ -1465,6 +1493,7 @@ class NodeService:
                 pass
 
     # ------------------------------------------------------------- handling
+    # concurrency: dispatcher-only
     def _handle(self, item: tuple) -> None:
         kind = item[0]
         if kind == "msg":
@@ -1511,6 +1540,7 @@ class NodeService:
         elif kind == "timer":
             item[1]()
 
+    # concurrency: dispatcher-only
     def _handle_burst(self, key: int, msgs: List[tuple]) -> None:
         """One receive burst from one connection, handled with a single
         scheduling pass at the end (mirrors SUBMIT_BATCH): a burst of
@@ -1532,6 +1562,7 @@ class NodeService:
         if not self._in_batch:
             self._dispatch()
 
+    # concurrency: dispatcher-only
     def _handle_msg(self, key: int, op: int, payload: Any) -> None:
         if op == P.REGISTER:
             kind, worker_id, pid = payload
@@ -1842,6 +1873,7 @@ class NodeService:
             return None
         return assignment[idx]
 
+    # concurrency: dispatcher-only
     def _queue_local(self, spec: P.TaskSpec, kind: str,
                      actor_spec: Optional[P.ActorSpec] = None) -> None:
         rec = _TaskRecord(spec=spec, kind=kind, actor_spec=actor_spec,
@@ -2058,6 +2090,7 @@ class NodeService:
         return meta
 
     # ------------------------------------------------------------- dispatch
+    # concurrency: dispatcher-only
     def _dispatch(self) -> None:
         """Scan the local queue, dispatching every task whose resources and
         worker are available (reference:
@@ -2696,6 +2729,7 @@ class NodeService:
         self._num_starting += 1
         return wid
 
+    # concurrency: dispatcher-only
     def _assign(self, rec: _TaskRecord, wid: WorkerID) -> None:
         telemetry.counter_inc(telemetry.M_TASKS_DISPATCHED, 1.0, self._mtags)
         telemetry.hist_observe(telemetry.M_QUEUE_WAIT,
@@ -2722,6 +2756,7 @@ class NodeService:
                                rec.actor_spec, rec.lease_seq))
 
     # ------------------------------------------------------------ completion
+    # concurrency: dispatcher-only
     def _task_done(self, conn_key: int, task_id, metas: List[ObjectMeta],
                    error: Optional[bytes], kind: str,
                    gen_count: Optional[int] = None) -> None:

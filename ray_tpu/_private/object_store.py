@@ -21,13 +21,13 @@ from __future__ import annotations
 import itertools
 import os
 import pickle
-import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from multiprocessing import shared_memory, resource_tracker
 from typing import Dict, List, Optional, Tuple
 
+from . import locksan
 from .config import CONFIG
 from .ids import ObjectID
 
@@ -172,7 +172,7 @@ class ObjectStore:
 
     def __init__(self, capacity_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None):
-        self._lock = threading.RLock()
+        self._lock = locksan.rlock("store.entries")
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._capacity = capacity_bytes or CONFIG.object_store_memory_mb * (1 << 20)
         self.ARENA_MAX_OBJECT = max(64 << 20, self._capacity // 4)
@@ -807,7 +807,7 @@ class ObjectReader:
 
     def __init__(self):
         self._segments: Dict[str, shared_memory.SharedMemory] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("store.reader_segments")
 
     def load(self, meta: ObjectMeta):
         from . import serialization

@@ -19,13 +19,13 @@ import pickle
 import socket
 import struct
 import sys
-import threading
 import time
 import traceback
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import locksan
 from . import telemetry
 from .config import CONFIG
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
@@ -43,6 +43,7 @@ FREE_OBJECTS = 8        # [ObjectID]
 KILL_ACTOR = 9          # (ActorID, no_restart)
 CANCEL_TASK = 10        # (TaskID, force)
 GET_NAMED_ACTOR = 11    # (req_id, name, namespace)
+# op 22 retired: SUBSCRIBE_EVENTS, superseded by GCS_SUBSCRIBE (op 36)
 KV_PUT = 12             # (key, value, overwrite)
 KV_GET = 13             # (req_id, key)
 KV_DEL = 14             # key
@@ -53,7 +54,6 @@ TASK_DONE = 18          # (task_id, [ObjectMeta], error|None, is_actor_creation)
 CREATE_PG = 19          # PlacementGroupSpec
 REMOVE_PG = 20          # PlacementGroupID
 ACTOR_EXIT = 21         # (actor_id, reason)
-SUBSCRIBE_EVENTS = 22   # (req_id, channel)
 STATE_QUERY = 23        # (req_id, what, filters)
 PROFILE_EVENT = 24      # (kind, payload)
 PUT_OBJECT_SYNC = 25    # (req_id, ObjectMeta) — acked once the store adopts it
@@ -170,7 +170,8 @@ NAMED_ACTOR_REPLY = 43  # (req_id, actor_info | None)
 KV_REPLY = 44           # (req_id, value)
 FUNCTION_REPLY = 45     # (req_id, blob | None)
 INFO_REPLY = 46         # (req_id, payload)
-ACTOR_STATE = 47        # (actor_id, state, reason) pushed to interested clients
+# op 47 retired: ACTOR_STATE pushes, superseded by the GCS "ACTOR"
+# pubsub channel (EVENT frames)
 SHUTDOWN = 48           # ()
 EVENT = 49              # (channel, payload)
 ERROR_REPLY = 50        # (req_id, pickled exception)
@@ -412,8 +413,8 @@ class Connection:
     def __init__(self, sock: socket.socket):
         self._sock = sock
         self._sendmsg = getattr(sock, "sendmsg", None)
-        self._qlock = threading.Lock()      # guards _outq + flags
-        self._flush_lock = threading.Lock() # held by the active drainer
+        self._qlock = locksan.lock("conn.queue")    # guards _outq + flags
+        self._flush_lock = locksan.lock("conn.flush")  # the active drainer
         self._outq: "deque" = deque()
         self._broken = False            # socket died under a drainer
         self._closing = False

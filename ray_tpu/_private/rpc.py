@@ -13,6 +13,7 @@ import threading
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from . import locksan
 from . import protocol as P
 
 
@@ -32,7 +33,7 @@ class RpcChannel:
         self._on_close = on_close
         self._reply_ops = set(reply_ops)
         self._futures: Dict[int, Future] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("rpc.futures")
         self._next_req = 1
         self._closed = threading.Event()
         conn.on_send_error = self._on_send_error

@@ -37,6 +37,7 @@ import warnings
 from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from . import locksan
 from .config import CONFIG
 
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
@@ -62,7 +63,7 @@ class _Hist:
 
 class _Shard:
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = locksan.lock("telemetry.shard")
         self.counters: Dict[tuple, list] = {}       # key -> [live, flushed]
         self.gauges: Dict[tuple, tuple] = {}        # key -> (value, ts)
         self.gauges_dirty: set = set()              # keys set since flush
@@ -75,14 +76,14 @@ _shards = [_Shard() for _ in range(_N_SHARDS)]
 # bucket layout per name); conflicting re-definitions warn and keep the
 # first definition instead of silently clobbering buckets
 _meta: Dict[str, dict] = {}
-_meta_lock = threading.Lock()
+_meta_lock = locksan.lock("telemetry.meta")
 _conflict_warned: set = set()
 
 # per-process node registry: NodeService instances sampled by the
 # sampler thread and used as the preferred flush transport (direct
 # plane call — no socket hop for node/head processes)
 _nodes: List[Any] = []
-_runtime_lock = threading.Lock()
+_runtime_lock = locksan.lock("telemetry.runtime")
 _flusher_started = False
 _sampler_started = False
 _last_flush = 0.0

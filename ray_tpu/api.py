@@ -8,10 +8,10 @@ Equivalent role to the reference's ``RemoteFunction``
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
 
 from ._private import context
+from ._private import locksan
 from ._private import protocol as P
 from ._private import serialization as ser
 from ._private.client import function_id_of
@@ -98,7 +98,7 @@ class RemoteFunction:
                                                     str(fn))
         self._blob: Optional[bytes] = None
         self._function_id: Optional[bytes] = None
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("api.remote_fn")
 
     def options(self, **options) -> "RemoteFunction":
         merged = {**self._options, **options}
@@ -231,7 +231,7 @@ class ActorHandle:
         self._class_name = class_name
         self._method_opts = method_opts or {}
         self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = locksan.lock("api.actor_seq")
 
     @property
     def actor_id(self) -> ActorID:
@@ -265,7 +265,7 @@ class ActorClass:
         self._cls = cls
         self._options = options
         self._blob: Optional[bytes] = None
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("api.actor_class")
 
     def options(self, **options) -> "ActorClass":
         merged = {**self._options, **options}

@@ -10,7 +10,8 @@ kills one.
 
 from __future__ import annotations
 
-import threading
+
+from .._private import locksan
 from typing import Any, Dict, List, Optional
 
 
@@ -42,7 +43,7 @@ class FakeNodeProvider(NodeProvider):
 
     def __init__(self, cluster):
         self._cluster = cluster
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("autoscaler.provider")
         self._nodes: List[dict] = []   # {"node": ..., "type": str}
 
     def create_node(self, node_type: str, resources: Dict[str, float],

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import asyncio
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -43,6 +42,7 @@ import numpy as np
 from .. import get, get_actor
 from ..api import remote
 from .._private import coll_transport
+from .._private import locksan
 from .._private import telemetry
 from .._private.config import CONFIG
 
@@ -252,7 +252,7 @@ class _GroupState:
 # Per-process registry (module-global like the reference's GroupManager,
 # ``collective.py:40``; actor methods may run on different threads).
 _process_groups: Dict[str, _GroupState] = {}
-_groups_lock = threading.Lock()
+_groups_lock = locksan.lock("collective.groups")
 
 
 def _groups() -> Dict[str, _GroupState]:

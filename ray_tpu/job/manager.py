@@ -26,6 +26,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from .._private import locksan
 from .._private import runtime_env as renv
 
 
@@ -47,7 +48,7 @@ class JobManager:
         os.makedirs(self.log_dir, exist_ok=True)
         self.session_dir = session_dir
         self._procs: Dict[str, subprocess.Popen] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("jobs.manager")
 
     # ------------------------------------------------------------- records
     def _key(self, job_id: str) -> bytes:

@@ -333,6 +333,30 @@ def cmd_job(args) -> None:
                      ["job_id", "status", "entrypoint", "return_code"])
 
 
+def cmd_lint(args) -> None:
+    """Run the repo's static lints: the observability-registry lint
+    (check_metrics) and the concurrency lint (check_concurrency) —
+    the same pair tier-1 gates on."""
+    from . import check_concurrency, check_metrics
+    an = check_concurrency.analyze()   # one package analysis, reused
+    rc = 0
+    for name, problems in (
+            ("metric-lint", check_metrics.check()),
+            ("concurrency-lint", check_concurrency.check(an=an))):
+        for p in problems:
+            print(f"{name}: {p}", file=sys.stderr)
+        if problems:
+            print(f"{name}: {len(problems)} problem(s)", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"{name}: ok")
+    for kind, rel, lineno, reason in check_concurrency.waiver_report(
+            an=an):
+        print(f"concurrency-lint: waiver {kind} at {rel}:{lineno}: "
+              f"{reason}")
+    raise SystemExit(rc)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="rtpu",
                                      description="ray_tpu cluster CLI")
@@ -340,6 +364,8 @@ def main(argv=None) -> None:
     sub = parser.add_subparsers(dest="command", required=True)
 
     sub.add_parser("status")
+    sub.add_parser(
+        "lint", help="static lints: metric registry + concurrency/lock-order")
     p_list = sub.add_parser("list")
     p_list.add_argument("what")
     p_list.add_argument("--limit", type=int, default=100)
@@ -419,6 +445,9 @@ def main(argv=None) -> None:
     p_job.set_defaults(needs_job_id=("status", "logs", "stop"))
 
     args = parser.parse_args(argv)
+    if args.command == "lint":
+        cmd_lint(args)
+        return
     if args.command == "start":
         cmd_start(args)
         return

@@ -15,6 +15,8 @@ import functools
 import queue as _queue
 import threading
 import time as _time
+
+from .._private import locksan
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional
 
@@ -27,7 +29,7 @@ class _Batcher:
         self.timeout_s = timeout_s
         self.q: "_queue.Queue" = _queue.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("serve.batcher")
 
     def _ensure_thread(self):
         with self._lock:

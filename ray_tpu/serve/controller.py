@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .._private import locksan
 from ..api import remote
 
 
@@ -24,7 +25,7 @@ class ServeController:
         # name -> {"deployment": Deployment, "replicas": [handles],
         #          "target": int}
         self._deployments: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("serve.controller")
         self._autoscale_thread = threading.Thread(
             target=self._autoscale_loop, daemon=True)
         self._autoscale_thread.start()

@@ -10,11 +10,11 @@ the controller's replica list on a TTL.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from .. import get
+from .._private import locksan
 
 _REFRESH_S = 1.0
 
@@ -30,7 +30,7 @@ class DeploymentHandle:
         # multiplexed-model cache holds the request's model)
         self._model_affinity: Dict[str, int] = {}
         self._last_refresh = 0.0
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("serve.handle")
         self._rng = random.Random()
 
     # -------------------------------------------------------------- routing

@@ -12,7 +12,8 @@ locality), and the replica's wrapper loads/evicts on demand.
 from __future__ import annotations
 
 import contextvars
-import threading
+
+from .._private import locksan
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
@@ -41,7 +42,7 @@ class _MultiplexCache:
         self._loader = loader
         self._max = max_models
         self._models: "OrderedDict[str, Any]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("serve.multiplex")
 
     def get(self, instance, model_id: str):
         with self._lock:

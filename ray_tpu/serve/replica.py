@@ -6,9 +6,9 @@ handle_request → user callable, queue metrics for autoscaling).
 
 from __future__ import annotations
 
-import threading
 import time
 
+from .._private import locksan
 from .._private import telemetry
 from ..api import remote
 
@@ -35,7 +35,7 @@ class Replica:
         else:
             self._instance = target          # plain function deployment
         self._depth = 0
-        self._depth_lock = threading.Lock()
+        self._depth_lock = locksan.lock("serve.replica_depth")
         self._mtags = (("deployment", deployment_name or "default"),)
 
     def _enter(self) -> None:

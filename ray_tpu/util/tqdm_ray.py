@@ -11,14 +11,15 @@ from __future__ import annotations
 
 import json
 import sys
-import threading
 import time
 import uuid
 from typing import Any, Iterable, Optional
 
 MAGIC = "__rtpu_tqdm__:"
 
-_render_lock = threading.Lock()
+from .._private import locksan
+
+_render_lock = locksan.lock("tqdm.render")
 _last_render: dict = {}            # bar_id -> state (driver side)
 
 

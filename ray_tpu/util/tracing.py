@@ -20,11 +20,12 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .._private import locksan
 from .._private.config import CONFIG
 
 _local = threading.local()
 _buffer: List[dict] = []
-_buffer_lock = threading.Lock()
+_buffer_lock = locksan.lock("tracing.buffer")
 _MAX_BUFFER = 10_000
 
 

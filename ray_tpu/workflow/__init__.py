@@ -24,6 +24,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
+from .._private import locksan
 from .._private import serialization as ser
 from ..dag import (ClassMethodNode, ClassNode, DAGInputData, DAGNode,
                    FunctionNode, InputAttributeNode, InputNode,
@@ -36,7 +37,7 @@ FAILED = "FAILED"
 RESUMABLE = "RESUMABLE"
 
 _storage_dir: Optional[str] = None
-_lock = threading.Lock()
+_lock = locksan.lock("workflow.registry")
 
 
 def init(storage: Optional[str] = None) -> None:

@@ -11,6 +11,14 @@ set before jax is imported anywhere in the process.
 
 import os
 
+# Lock-order sanitizer (ISSUE 7): every tier-1 test doubles as a
+# sanitizer run — locksan wraps every declared runtime lock, checks the
+# DESIGN.md hierarchy, and detects cross-thread A->B/B->A inversions
+# online. setdefault so perf-sensitive runs can opt out with
+# RTPU_LOCKSAN=0; must be set BEFORE ray_tpu (and any spawned worker,
+# which inherits the env) imports locksan.
+os.environ.setdefault("RTPU_LOCKSAN", "1")
+
 # The axon sitecustomize pins JAX_PLATFORMS=axon (real chip); tests run on
 # a virtual 8-device CPU mesh, which needs both the env override and the
 # config update (the sitecustomize's register() call re-adds axon).
@@ -27,6 +35,18 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 import ray_tpu  # noqa: E402
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # surface driver-process sanitizer reports in the summary (worker
+    # processes print theirs to worker logs, forwarded to stdout live)
+    from ray_tpu._private import locksan
+
+    v = locksan.violations()
+    if v:
+        print(f"\n[locksan] {len(v)} lock-order violation(s) observed "
+              "in the driver process — see [locksan] stderr reports "
+              "above")
 
 
 @pytest.fixture

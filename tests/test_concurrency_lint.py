@@ -1,0 +1,465 @@
+"""Tier-1 wiring of the concurrency lint (scripts/check_concurrency.py)
+and the runtime lock-order sanitizer (_private/locksan.py).
+
+The first test is the gate: the analyzer must exit clean on the real
+package (zero unwaived findings). The fixture tests pin each rule's
+behavior on synthetic packages so a regression in the analyzer itself
+can't silently turn the gate vacuous. The locksan tests construct a
+real A->B / B->A deadlock across two threads and assert the sanitizer
+reports (and, in raise mode, prevents) it before the threads wedge.
+"""
+
+import ast
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import locksan
+from ray_tpu.scripts import check_concurrency as cc
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- the gate
+
+def test_package_is_clean():
+    problems = cc.check(_REPO)
+    assert problems == [], "\n".join(problems)
+
+
+def test_every_waiver_carries_a_reason():
+    waivers = cc.waiver_report(_REPO)
+    assert waivers, "expected the known deliberate waivers to exist"
+    for kind, rel, lineno, reason in waivers:
+        assert reason.strip(), f"empty waiver reason at {rel}:{lineno}"
+
+
+def test_scanner_sees_known_locks_and_ops():
+    """A broken scanner must not vacuously pass the gate."""
+    files = cc._walk_files(os.path.join(_REPO, "ray_tpu"))
+    reg = cc.parse_locksan_registry(files)
+    for name in ("gcs.plane", "node.res", "conn.queue", "client.ref",
+                 "store.entries", "coll.mailbox", "telemetry.shard"):
+        assert name in reg, name
+    _raw, sites, bindings = cc.collect_lock_sites(files)
+    assert len(sites) >= 30
+    assert bindings[("_private/gcs.py", "GlobalControlPlane",
+                     "_lock")] == "gcs.plane"
+    ops = cc._collect_protocol_ops(files)
+    for op in ("SUBMIT_TASK", "TASK_DONE", "EXECUTE_TASK", "COLL_ROUTE",
+               "RETURN_LEASED", "SHUTDOWN", "ACTOR_EXIT"):
+        assert op in ops, op
+
+
+# ------------------------------------------------ fixture-repo harness
+
+_DESIGN_OK = """# x
+## Threading model & lock hierarchy
+
+| Lock | Module | Level | Kind | Protects |
+|---|---|---|---|---|
+| `t.a` | `mod.py` | 10 | lock | a |
+| `t.b` | `mod.py` | 20 | lock | b |
+
+## next
+"""
+
+_README_OK = """# x
+## Configuration
+
+| Knob | Env override | Default | What it does |
+|---|---|---|---|
+| `some_knob` | `RTPU_SOME_KNOB` | `1` | x |
+
+## next
+"""
+
+_CONFIG_SRC = '_CONFIG_DEFS = {"some_knob": (int, 1, "x")}\n'
+
+
+def _mk_repo(tmp_path, files, design=_DESIGN_OK, readme=_README_OK):
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    base = {
+        "locksan.py": ('REGISTRY = {"t.a": ("mod.py", "lock", 10, "a"),'
+                       ' "t.b": ("mod.py", "lock", 20, "b")}\n'),
+        "config.py": _CONFIG_SRC,
+    }
+    base.update(files)
+    for rel, src in base.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    (tmp_path / "DESIGN.md").write_text(design)
+    (tmp_path / "README.md").write_text(readme)
+    return str(tmp_path)
+
+
+_MOD_HEADER = (
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._a = locksan.lock(\"t.a\")\n"
+    "        self._b = locksan.lock(\"t.b\")\n")
+
+
+def test_fixture_baseline_is_clean(tmp_path):
+    root = _mk_repo(tmp_path, {"mod.py": _MOD_HEADER})
+    problems = [p for p in cc.check(root)
+                if "scanner is broken" not in p
+                and "reader root" not in p
+                and "no op constants" not in p
+                and "no handler" not in p]
+    assert problems == [], "\n".join(problems)
+
+
+def test_undeclared_raw_lock_flagged(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "mod.py": _MOD_HEADER + (
+            "    def extra(self):\n"
+            "        self._c = threading.Lock()\n")})
+    problems = cc.check(root)
+    assert any("raw threading.Lock()" in p for p in problems), problems
+
+
+def test_unregistered_factory_name_flagged(tmp_path):
+    root = _mk_repo(tmp_path, {
+        "mod.py": _MOD_HEADER.replace('"t.b"', '"t.mystery"')})
+    problems = cc.check(root)
+    assert any("'t.mystery' is not declared" in p for p in problems)
+    # and the now-unconstructed registry row is stale
+    assert any("'t.b'" in p and "stale registry row" in p
+               for p in problems)
+
+
+def test_inversion_cycle_flagged(tmp_path):
+    src = _MOD_HEADER + (
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._b:\n"
+        "            self.h()\n"
+        "    def h(self):\n"
+        "        with self._a:\n"
+        "            pass\n")
+    root = _mk_repo(tmp_path, {"mod.py": src})
+    problems = cc.check(root)
+    # g->h propagates the a-under-b edge through the call graph:
+    # both the downhill edge and the a->b->a cycle are reported
+    assert any("violates the declared strictly-increasing hierarchy"
+               in p for p in problems), problems
+    assert any("lock-order cycle" in p and "t.a" in p and "t.b" in p
+               for p in problems), problems
+
+
+def test_self_deadlock_on_plain_lock_flagged(tmp_path):
+    src = _MOD_HEADER + (
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            self.g()\n"
+        "    def g(self):\n"
+        "        with self._a:\n"
+        "            pass\n")
+    root = _mk_repo(tmp_path, {"mod.py": src})
+    problems = cc.check(root)
+    assert any("self-deadlock" in p for p in problems), problems
+
+
+def test_send_under_lock_flagged_and_waivable(tmp_path):
+    src = _MOD_HEADER + (
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            self.conn.send((1, 2))\n"
+        "    def ok(self):\n"
+        "        with self._a:\n"
+        "            self.conn.send((1, 2))  "
+        "# lint: allow-under-lock(frame order is the invariant)\n")
+    root = _mk_repo(tmp_path, {"mod.py": src})
+    problems = cc.check(root)
+    hits = [p for p in problems if "blocking .send()" in p]
+    assert len(hits) == 1, problems     # f flagged, ok's waiver honored
+    waivers = cc.waiver_report(root)
+    assert any(r == "frame order is the invariant"
+               for _k, _rel, _ln, r in waivers)
+
+
+def test_empty_waiver_reason_flagged(tmp_path):
+    src = _MOD_HEADER + (
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            self.conn.send((1, 2))  "
+        "# lint: allow-under-lock()\n")
+    root = _mk_repo(tmp_path, {"mod.py": src})
+    problems = cc.check(root)
+    assert any("empty reason" in p for p in problems), problems
+
+
+def test_gcs_rpc_under_lock_flagged(tmp_path):
+    src = _MOD_HEADER + (
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            self.gcs.kv_get(b'k')\n")
+    root = _mk_repo(tmp_path, {"mod.py": src})
+    problems = cc.check(root)
+    assert any("synchronous GCS RPC .kv_get()" in p
+               for p in problems), problems
+
+
+def test_reader_calling_dispatcher_only_flagged(tmp_path):
+    node_src = (
+        "class NodeService:\n"
+        "    def _handle_direct(self, key, op, payload):\n"
+        "        self._dispatch()\n"
+        "    # concurrency: dispatcher-only\n"
+        "    def _dispatch(self):\n"
+        "        pass\n")
+    root = _mk_repo(tmp_path, {"_private/node.py": node_src,
+                               "mod.py": _MOD_HEADER})
+    problems = cc.check(root)
+    assert any("calls dispatcher-only function '_dispatch'" in p
+               for p in problems), problems
+
+
+def test_reader_blocking_wait_flagged(tmp_path):
+    node_src = (
+        "class NodeService:\n"
+        "    def _handle_direct(self, key, op, payload):\n"
+        "        self._collect()\n"
+        "    def _collect(self):\n"
+        "        fut.result(timeout=1)\n")
+    root = _mk_repo(tmp_path, {"_private/node.py": node_src,
+                               "mod.py": _MOD_HEADER})
+    problems = cc.check(root)
+    assert any("blocks in .result()" in p
+               and "_handle_direct -> _collect" in p
+               for p in problems), problems
+
+
+_PROTO_FIXTURE = (
+    "OP_A = 1\n"
+    "OP_B = 2\n"
+    "OP_C = 3            # lint: allow-op(one-sided by design)\n"
+)
+
+
+def test_protocol_arity_mismatch_flagged(tmp_path):
+    sender = ("from . import protocol as P\n"
+              "def s1(conn, x):\n"
+              "    conn.send((P.OP_A, (x, x)))\n"
+              "def s2(conn, x):\n"
+              "    conn.send((P.OP_A, (x, x, x)))\n"
+              "def s3(conn, x):\n"
+              "    conn.send((P.OP_B, (x, x)))\n")
+    handler = ("from . import protocol as P\n"
+               "def handle(op, payload):\n"
+               "    if op == P.OP_A:\n"
+               "        a, b = payload\n"
+               "    elif op == P.OP_B:\n"
+               "        a, b, c = payload\n")
+    root = _mk_repo(tmp_path, {"_private/protocol.py": _PROTO_FIXTURE,
+                               "_private/snd.py": sender,
+                               "_private/hnd.py": handler,
+                               "mod.py": _MOD_HEADER})
+    problems = cc.check(root)
+    assert any("OP_A: send sites disagree" in p for p in problems)
+    assert any("OP_B" in p and "2-tuple payload" in p
+               and "unpacks 3" in p for p in problems), problems
+    # the allow-op'd one-sided op stays silent
+    assert not any("OP_C" in p for p in problems)
+
+
+def test_dead_and_unsent_ops_flagged(tmp_path):
+    handler = ("from . import protocol as P\n"
+               "def handle(op, payload):\n"
+               "    if op == P.OP_B:\n"
+               "        pass\n")
+    root = _mk_repo(tmp_path, {"_private/protocol.py": _PROTO_FIXTURE,
+                               "_private/hnd.py": handler,
+                               "mod.py": _MOD_HEADER})
+    problems = cc.check(root)
+    assert any("OP_A: dead" in p for p in problems), problems
+    assert any("OP_B: handled but never sent" in p for p in problems)
+
+
+def test_config_knob_drift_flagged(tmp_path):
+    readme = _README_OK.replace("`RTPU_SOME_KNOB`", "`RTPU_WRONG`")
+    root = _mk_repo(tmp_path, {"mod.py": _MOD_HEADER}, readme=readme)
+    problems = cc.check(root)
+    assert any("env column says RTPU_WRONG" in p for p in problems)
+
+
+def test_undocumented_knob_and_typo_read_flagged(tmp_path):
+    src = _MOD_HEADER + (
+        "    def f(self):\n"
+        "        return CONFIG.sme_knob\n")   # typo'd read
+    readme = _README_OK.replace(
+        "| `some_knob` | `RTPU_SOME_KNOB` | `1` | x |\n", "")
+    root = _mk_repo(tmp_path, {"mod.py": src}, readme=readme)
+    problems = cc.check(root)
+    assert any("'some_knob'" in p and "not documented" in p
+               for p in problems), problems
+    assert any("CONFIG.sme_knob is not a defined knob" in p
+               for p in problems), problems
+
+
+def test_design_table_drift_flagged(tmp_path):
+    design = _DESIGN_OK.replace("| `t.b` | `mod.py` | 20 | lock | b |",
+                                "| `t.b` | `mod.py` | 5 | lock | b |")
+    root = _mk_repo(tmp_path, {"mod.py": _MOD_HEADER}, design=design)
+    problems = cc.check(root)
+    assert any("'t.b'" in p and "DESIGN.md row" in p
+               and "disagrees" in p for p in problems), problems
+
+
+# ------------------------------------------------------ locksan runtime
+
+@pytest.fixture
+def san_state():
+    """Snapshot/restore sanitizer mode + violation list around a test."""
+    prev_mode = locksan.set_mode("log")
+    locksan.clear_violations()
+    yield
+    locksan.set_mode(prev_mode)
+    locksan.clear_violations()
+
+
+def test_locksan_enabled_under_tier1():
+    # conftest sets RTPU_LOCKSAN=1 before importing ray_tpu, so the
+    # whole suite doubles as a sanitizer run
+    assert locksan.enabled()
+
+
+def test_locksan_detects_ab_ba_deadlock_before_wedge(san_state):
+    """Two threads take t1: A then B, t2: B then A. In raise mode the
+    second thread's acquire is REFUSED at the inversion, so both
+    threads finish instead of wedging — the sanitizer reports the
+    deadlock before it happens."""
+    a = locksan.lock("test.dead.a")
+    b = locksan.lock("test.dead.b")
+    locksan.set_mode("raise")
+    hit = []
+    barrier = threading.Barrier(2, timeout=5)
+
+    def t1():
+        with a:
+            barrier.wait()          # both hold their first lock
+            time.sleep(0.05)
+            try:
+                with b:
+                    pass
+            except locksan.LockOrderViolation as e:
+                hit.append(("t1", e))
+
+    def t2():
+        with b:
+            barrier.wait()
+            time.sleep(0.05)
+            try:
+                with a:
+                    pass
+            except locksan.LockOrderViolation as e:
+                hit.append(("t2", e))
+
+    th1 = threading.Thread(target=t1, daemon=True)
+    th2 = threading.Thread(target=t2, daemon=True)
+    th1.start()
+    th2.start()
+    th1.join(timeout=10)
+    th2.join(timeout=10)
+    assert not th1.is_alive() and not th2.is_alive(), \
+        "threads wedged — the sanitizer failed to break the deadlock"
+    assert hit, "no order-cycle violation raised"
+    recs = [v for v in locksan.violations()
+            if v["kind"] == "order-cycle"]
+    assert recs and "test.dead" in recs[0]["message"]
+
+
+def test_locksan_hierarchy_violation(san_state):
+    locksan.REGISTRY["test.low"] = ("t.py", "lock", 1, "x")
+    locksan.REGISTRY["test.high"] = ("t.py", "lock", 2, "x")
+    try:
+        low = locksan.lock("test.low")
+        high = locksan.lock("test.high")
+        with high:
+            with low:               # downhill: declared order is low->high
+                pass
+        v = [x for x in locksan.violations() if x["kind"] == "hierarchy"]
+        assert v and "test.low" in v[0]["message"]
+        locksan.clear_violations()
+        # fresh instances: the first pair's order graph now (correctly)
+        # holds the high->low edge, so reusing them uphill would be the
+        # observed-both-orders inversion
+        low2 = locksan.lock("test.low")
+        high2 = locksan.lock("test.high")
+        with low2:
+            with high2:             # uphill: clean
+                pass
+        assert not locksan.violations()
+    finally:
+        del locksan.REGISTRY["test.low"]
+        del locksan.REGISTRY["test.high"]
+
+
+def test_locksan_plain_lock_self_reacquire_reported(san_state):
+    lk = locksan.lock("test.selfdead")
+    locksan.set_mode("raise")
+    with lk:
+        with pytest.raises(locksan.LockOrderViolation):
+            lk.acquire()
+
+
+def test_locksan_rlock_reentry_clean(san_state):
+    rl = locksan.rlock("test.re")
+    with rl:
+        with rl:
+            pass
+    assert not locksan.violations()
+
+
+def test_locksan_condition_releases_held_state_across_wait(san_state):
+    """Condition.wait releases through the wrapper, so a waiter parked
+    on the mailbox condvar is NOT 'holding' the lock — the depositing
+    thread's acquire stays clean (the coll_transport pattern)."""
+    lk = locksan.lock("test.cv")
+    cv = locksan.condition("test.cv", lk)
+    got = []
+
+    def waiter():
+        with cv:
+            while not got:
+                cv.wait(timeout=5)
+            got.append("woke")
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    time.sleep(0.05)
+    with cv:
+        got.append("x")
+        cv.notify_all()
+    th.join(timeout=5)
+    assert not th.is_alive() and "woke" in got
+    assert not locksan.violations()
+
+
+def test_locksan_disabled_returns_plain_primitives(monkeypatch):
+    monkeypatch.setattr(locksan, "_ENABLED", False)
+    lk = locksan.lock("whatever")
+    assert type(lk) is type(threading.Lock())
+    rl = locksan.rlock("whatever")
+    assert "RLock" in type(rl).__name__
+
+
+def test_try_lock_and_timeout_acquire_pass_through(san_state):
+    """The transport's opportunistic drainer pattern: try-locks and
+    timed acquires never trip checks and keep held-state exact."""
+    a = locksan.lock("test.try.a")
+    assert a.acquire(blocking=False)
+    assert not a.acquire(blocking=False)
+    a.release()
+    assert a.acquire(timeout=0.5)
+    assert a.locked()
+    a.release()
+    assert not locksan.violations()

@@ -273,3 +273,44 @@ def test_actor_dep_wait_does_not_block_other_submitters(rtpu_init):
     # driver's call still waits on its dep
     out = ray_tpu.get(other_submitter.remote(log), timeout=15)
     assert out == ["warmup", "other"]
+
+
+def test_exit_actor(rtpu_init):
+    """ISSUE 7 regression: ACTOR_EXIT had a handler but no sender —
+    ``exit_actor()`` is the API that emits it. The exiting call's
+    caller observes the death, the actor is NOT restarted (even with
+    restarts budgeted), and further calls fail with ActorDiedError."""
+
+    @ray_tpu.remote(max_restarts=2)
+    class Quitter:
+        def ping(self):
+            return "pong"
+
+        def leave(self):
+            ray_tpu.exit_actor()
+            return "unreachable"
+
+    a = Quitter.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    with pytest.raises((ray_tpu.exceptions.ActorDiedError,
+                        ray_tpu.exceptions.TaskError)):
+        ray_tpu.get(a.leave.remote(), timeout=60)
+    # intentional exit suppresses the restart budget: the actor stays
+    # dead instead of coming back as a fresh instance
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=10)
+        except ray_tpu.exceptions.ActorDiedError:
+            break
+        except ray_tpu.exceptions.GetTimeoutError:
+            continue
+        time.sleep(0.2)
+    else:
+        raise AssertionError("actor answered after exit_actor() "
+                             "(restarted or never died)")
+
+
+def test_exit_actor_outside_actor_raises(rtpu_init):
+    with pytest.raises(RuntimeError):
+        ray_tpu.exit_actor()
