@@ -244,6 +244,88 @@ def collective_ab() -> tuple:
     return out["ring"], out["star"]
 
 
+def hierarchical_ab() -> dict:
+    """Hierarchical-vs-flat gate on a 2-node x 2-rank IN-PROCESS
+    cluster (8 MB float32 allreduce), plus the quantized-vs-exact
+    wire-bytes gate.
+
+    The hard gates are the DETERMINISTIC byte counts: the hierarchical
+    schedule must cross the node plane with fewer bytes than the flat
+    ring (measured ~0.67x at 2 ranks/node), and int8-blockscale must
+    at least halve the exact hierarchical cross bytes (measured
+    ~0.25x). Wall-clock ratios are reported with a loose tripwire
+    only: in-process "cross-node" hops cost the same as local ones
+    (one driver process routes everything), so the latency win of
+    cutting cross-wire bytes does not materialize here — the same-box
+    OS-isolated A/B in the PR log is the wall-clock evidence. A
+    pathological regression (schedule serializing, timeout-retry) still
+    overshoots the tripwire."""
+    import statistics as _st
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.comm import collective as col
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2,
+                                      "resources": {"a": 4.0}})
+    cluster.add_node(num_cpus=2, resources={"b": 4.0})
+    ray_tpu.init(address=cluster)
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        class Rank(col.CollectiveActorMixin):
+            def __init__(self):
+                self.x = np.ones(2_097_152, np.float32)     # 8 MB
+
+            def configure(self, algo, wire):
+                from ray_tpu._private.config import CONFIG as C
+                C._values["collective_algo"] = algo
+                C._values["collective_wire_dtype"] = wire
+                return True
+
+            def bench(self, rounds):
+                from ray_tpu._private import coll_transport
+                before = coll_transport.stats()["sent_remote_bytes"]
+                for _ in range(rounds):
+                    col.allreduce(self.x)
+                return (coll_transport.stats()["sent_remote_bytes"]
+                        - before)
+
+        members = ([Rank.options(resources={"a": 1.0}).remote()
+                    for _ in range(2)]
+                   + [Rank.options(resources={"b": 1.0}).remote()
+                      for _ in range(2)])
+        col.create_collective_group(members, 4, [0, 1, 2, 3])
+        arms = (("ring", "exact"), ("hierarchical", "exact"),
+                ("hierarchical", "int8-blockscale"))
+        times = {a: [] for a in arms}
+        remote = {}
+        for algo, wire in arms:                         # warm the paths
+            ray_tpu.get([m.configure.remote(algo, wire) for m in members])
+            remote[(algo, wire)] = sum(ray_tpu.get(
+                [m.bench.remote(1) for m in members], timeout=120))
+        rounds = 3
+        for _ in range(5):                  # interleaved, median-of-5
+            for arm in arms:
+                ray_tpu.get([m.configure.remote(*arm) for m in members])
+                t0 = time.perf_counter()
+                ray_tpu.get([m.bench.remote(rounds) for m in members],
+                            timeout=300)
+                times[arm].append((time.perf_counter() - t0) / rounds)
+        return {
+            "flat_s": _st.median(times[("ring", "exact")]),
+            "hier_s": _st.median(times[("hierarchical", "exact")]),
+            "hier_q8_s": _st.median(
+                times[("hierarchical", "int8-blockscale")]),
+            "flat_remote_bytes": remote[("ring", "exact")],
+            "hier_remote_bytes": remote[("hierarchical", "exact")],
+            "q8_remote_bytes": remote[("hierarchical",
+                                       "int8-blockscale")],
+        }
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def async_dispatch_ab(nop) -> tuple:
     """Same-box A/B of worker-lease pipelining: a tiny-task submit burst
     with the shipped ``worker_pipeline_depth`` vs depth 1 (leases off).
@@ -368,7 +450,7 @@ def main() -> None:
               and profile_ratio < 1.4 and prof_samples > 0
               and transport_ratio < 1.75 and collective_ratio < 0.9
               and dispatch_ratio < 1.05)
-        print(json.dumps({
+        payload = {
             "metric": "telemetry_overhead",
             "submit_on_s": round(sub_on, 4),
             "submit_off_s": round(sub_off, 4),
@@ -390,10 +472,36 @@ def main() -> None:
             "dispatch_pipelined_s": round(dispatch_piped_s, 4),
             "dispatch_depth1_s": round(dispatch_d1_s, 4),
             "dispatch_ratio": round(dispatch_ratio, 3),
-            "pass": ok,
-        }), flush=True)
+        }
     finally:
         ray_tpu.shutdown()
+    # hierarchical + quantized collective gates (own 2-node cluster —
+    # must run after the single-node session above shut down)
+    hier = hierarchical_ab()
+    hier_wire_ratio = (hier["hier_remote_bytes"]
+                       / max(hier["flat_remote_bytes"], 1))
+    q8_wire_ratio = (hier["q8_remote_bytes"]
+                     / max(hier["hier_remote_bytes"], 1))
+    hier_wall_ratio = hier["hier_q8_s"] / max(hier["flat_s"], 1e-9)
+    # deterministic wire gates carry the weight (measured 0.67 / 0.25);
+    # the wall ratio is a tripwire only (see hierarchical_ab's
+    # docstring): loopback "cross-node" hops cost the same as local
+    # ones and the leader concentrates ~2x a member's bytes, so
+    # measured medians sit at 1.1-1.4 on this box (loaded runs reach
+    # ~1.75); 2.5 only trips on the schedule-serializing /
+    # timeout-retry regression class
+    ok = (ok and hier_wire_ratio < 0.85 and q8_wire_ratio <= 0.5
+          and hier_wall_ratio < 2.5)
+    payload.update({
+        "hier_flat_s": round(hier["flat_s"], 4),
+        "hier_exact_s": round(hier["hier_s"], 4),
+        "hier_q8_s": round(hier["hier_q8_s"], 4),
+        "hier_wire_ratio": round(hier_wire_ratio, 3),
+        "q8_wire_ratio": round(q8_wire_ratio, 3),
+        "hier_wall_ratio": round(hier_wall_ratio, 3),
+        "pass": ok,
+    })
+    print(json.dumps(payload), flush=True)
 
 
 if __name__ == "__main__":

@@ -49,9 +49,26 @@ _born: Dict[tuple, float] = {}
 _next_sweep = [0.0]             # guarded by _lock
 
 # plain per-process counters for tests/diagnostics (no shard-lock cost);
-# single-writer per field in practice (the rank thread / reader thread)
+# single-writer per field in practice (the rank thread / reader thread).
+# sent_remote_* count only chunks addressed to a DIFFERENT node — the
+# traffic that actually crosses the node plane (COLL_FWD), which is what
+# hierarchical schedules and the quantized wire format exist to shrink.
 _stats = {"sent_chunks": 0, "sent_bytes": 0, "recv_chunks": 0,
-          "recv_bytes": 0}
+          "recv_bytes": 0, "sent_remote_chunks": 0, "sent_remote_bytes": 0}
+
+
+def payload_nbytes(payload) -> int:
+    """Wire-payload size of one chunk: ndarray / QuantChunk ``nbytes``,
+    recursed through tuples/lists (hierarchical allgather ships bundles
+    of per-rank arrays in one mailbox message)."""
+    nbytes = getattr(payload, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    if isinstance(payload, (tuple, list)):
+        return sum(payload_nbytes(v) for v in payload)
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return len(payload)
+    return 0
 
 
 def local_endpoint() -> Optional[Tuple[bytes, bytes]]:
@@ -69,10 +86,13 @@ def send(dest: Tuple[bytes, bytes], key: tuple, payload,
     forget: delivery failures surface as the receiver's deadline."""
     from . import protocol as P
     client = context.require_client()
-    nbytes = int(getattr(payload, "nbytes", 0) or 0)
+    nbytes = payload_nbytes(payload)
     client.conn.send((P.COLL_ROUTE, (dest[0], dest[1], key, payload)))
     _stats["sent_chunks"] += 1
     _stats["sent_bytes"] += nbytes
+    if client.node_id is not None and dest[0] != client.node_id.binary():
+        _stats["sent_remote_chunks"] += 1
+        _stats["sent_remote_bytes"] += nbytes
     tags = (("group", group), ("op", op))
     telemetry.counter_inc(M_COLL_CHUNKS, 1.0, tags)
     if nbytes:
@@ -94,7 +114,7 @@ def deposit(key: tuple, value) -> None:
         n = len(_slots)
         _cond.notify_all()
     _stats["recv_chunks"] += 1
-    _stats["recv_bytes"] += int(getattr(value, "nbytes", 0) or 0)
+    _stats["recv_bytes"] += payload_nbytes(value)
     telemetry.gauge_set(M_COLL_INFLIGHT, float(n))
 
 

@@ -223,6 +223,33 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                "over the zero-copy transport; off = "
                                "degenerate fallback through the "
                                "coordinator actor (control plane)"),
+    "collective_algo": (str, "auto",
+                        "force one collective schedule (ring | tree | "
+                        "hierarchical | star); auto consults the "
+                        "size x topology x dtype selection table "
+                        "(_select_schedule) per call"),
+    "collective_hierarchical_threshold_bytes": (int, 256 << 10,
+                                                "payloads at/above this on a "
+                                                "multi-node group with "
+                                                "co-located ranks run the "
+                                                "two-level hierarchical "
+                                                "schedule (intra-node reduce "
+                                                "-> inter-node leader ring "
+                                                "-> intra-node broadcast); "
+                                                "below it the flat ring's "
+                                                "fewer staging hops win"),
+    "collective_wire_dtype": (str, "exact",
+                              "wire precision of INTER-node hops in "
+                              "hierarchical reductions: exact (default, "
+                              "bit-exact) | bf16 (~2x wire reduction) | "
+                              "int8-blockscale (~4x, per-block max-abs "
+                              "scales). Intra-node hops and non-reduction "
+                              "ops always stay exact"),
+    "collective_quant_block_elems": (int, 256,
+                                     "block size (elements) of the "
+                                     "int8-blockscale wire format; one "
+                                     "float32 scale rides along per "
+                                     "block"),
     "object_transfer_chunk_bytes": (int, 8 << 20,
                                     "cross-host object pulls stream in "
                                     "chunks of this size (reference: "

@@ -10,7 +10,7 @@ completion is driven by connection reader threads waking condition
 variables — no polling anywhere on the data path.
 
 Algorithms (reference model: "The Big Send-off" / bandwidth-optimal
-collective schedules):
+collective schedules, EQuARX block-quantized allreduce):
 
 - **ring allreduce** = reduce-scatter + allgather over the rank ring,
   tensors split into ``collective_chunk_bytes`` chunks so chunk k+1
@@ -21,7 +21,24 @@ collective schedules):
   small-payload **tree allreduce** below
   ``collective_tree_threshold_bytes`` (latency-bound regime: 2·log2(w)
   hops beat a 2·(w-1)-step ring).
+- **hierarchical two-level schedules** on multi-node groups with
+  co-located ranks: intra-node reduce to one elected leader per node
+  (those hops ride the same-host fast path) -> inter-node ring among
+  the leaders only -> intra-node broadcast, so cross-wire traffic is
+  ~1/ranks-per-node of a flat ring's.
+- **block-quantized wire format** (``collective_wire_dtype`` = exact |
+  bf16 | int8-blockscale): inter-node hops of hierarchical REDUCTIONS
+  dequantize -> reduce -> requantize per hop, trading bounded
+  max-abs error for 2-4x wire reduction; intra-node hops and ops that
+  relay caller bytes verbatim (broadcast/allgather/send/recv) always
+  stay exact, and the reduce order stays deterministic, so every rank
+  still returns bit-identical bytes.
 - **send/recv** are direct rank-to-rank mailbox messages.
+
+Every public op picks its schedule through ONE table —
+``_select_schedule(op, nbytes, world, nodes, dtype)`` — overridable
+with ``collective_algo``; choices are observable via
+``rtpu_collective_algo_total{algo,op}``.
 
 The named ``_Coordinator`` actor is control plane only: group
 membership, rank -> endpoint exchange, epoch agreement — plus a
@@ -58,6 +75,15 @@ M_COLL_BYTES = telemetry.define(
 M_COLL_OPS = telemetry.define(
     "counter", "rtpu_collective_ops_total",
     "Collective calls completed by this rank")
+M_COLL_ALGO = telemetry.define(
+    "counter", "rtpu_collective_algo_total",
+    "Collective calls by the schedule the size x topology x dtype "
+    "selector chose (ring/tree/hierarchical/star/local) — makes the "
+    "crossover points observable")
+M_COLL_QUANT_SAVED = telemetry.define(
+    "counter", "rtpu_collective_quantized_bytes_total",
+    "Wire bytes SAVED by the block-quantized inter-node format "
+    "(original minus encoded payload bytes, summed over quantized hops)")
 
 
 def _observe(op: str, group: str, nbytes: int, t0: float) -> None:
@@ -66,6 +92,10 @@ def _observe(op: str, group: str, nbytes: int, t0: float) -> None:
     if nbytes:
         telemetry.counter_inc(M_COLL_BYTES, float(nbytes), tags)
     telemetry.hist_observe(M_COLL_LATENCY, time.monotonic() - t0, tags)
+
+
+def _observe_algo(op: str, algo: str) -> None:
+    telemetry.counter_inc(M_COLL_ALGO, 1.0, (("algo", algo), ("op", op)))
 
 # ops
 SUM = "sum"
@@ -77,6 +107,208 @@ MAX = "max"
 # O(size) (the seed's np.stack over world_size arrays was O(world*size))
 # and, unlike np.sum's axis reduction, never promotes the dtype
 _BINARY = {SUM: np.add, PROD: np.multiply, MIN: np.minimum, MAX: np.maximum}
+
+
+# ------------------------------------------- block-quantized wire format
+#
+# EQuARX-style precision/bandwidth trade on the hops that actually cross
+# a wire: inter-node legs of hierarchical REDUCTIONS encode each chunk
+# to bf16 or per-block-scaled int8 before it enters the transport's OOB
+# frames, and the receiving rank thread dequantizes after the mailbox
+# wait (reader threads stay lean — rule 4 of the threading model). Ops
+# that relay caller bytes verbatim (broadcast/allgather/send/recv) and
+# every intra-node hop are never quantized.
+
+_WIRE_DTYPES = ("exact", "bf16", "int8-blockscale")
+
+
+class QuantChunk:
+    """Wire form of one quantized chunk — self-describing, so a receiver
+    needs no schedule context to decode. ``q`` (the bf16 bit pattern or
+    the int8 mantissas) rides out-of-band like plain ndarray chunks;
+    ``scales`` is None for bf16. ``dtype`` is the ORIGINAL dtype the
+    decoder restores (reduction then proceeds in that dtype, keeping
+    the deterministic reduce order of the exact schedules)."""
+
+    __slots__ = ("mode", "dtype", "q", "scales")
+
+    def __init__(self, mode: str, dtype: str, q, scales=None):
+        self.mode = mode
+        self.dtype = dtype
+        self.q = q
+        self.scales = scales
+
+    @property
+    def nbytes(self) -> int:
+        # also consulted by the transport's _est_size so chunk bursts
+        # don't over-coalesce into one giant BATCH frame
+        n = int(self.q.nbytes)
+        if self.scales is not None:
+            n += int(self.scales.nbytes)
+        return n
+
+
+def _bf16_encode(x32: np.ndarray) -> np.ndarray:
+    """float32 -> bfloat16 bit pattern (uint16), round-to-nearest-even
+    (numpy has no native bfloat16; the bit trick is exact)."""
+    u = x32.view(np.uint32)
+    return (((u + 0x7FFF + ((u >> 16) & 1)) >> 16)).astype(np.uint16)
+
+
+def _bf16_decode(u16: np.ndarray) -> np.ndarray:
+    return (u16.astype(np.uint32) << 16).view(np.float32)
+
+
+def _q8_block_counts(n: int, block: int) -> Tuple[np.ndarray, np.ndarray]:
+    idx = np.arange(0, n, block, dtype=np.int64)
+    counts = np.full(idx.size, block, dtype=np.int64)
+    counts[-1] = n - idx[-1]
+    return idx, counts
+
+
+class _WireCodec:
+    """Encoder/decoder for the inter-node hops of one collective call.
+
+    ``encode`` is the identity for exact mode, non-float dtypes
+    (integer reductions must stay exact) and empty chunks; ``decode``
+    of a plain ndarray is the identity, so exact and quantized traffic
+    can share one schedule. ``saved`` accumulates original-minus-
+    encoded bytes for the wire-savings counter."""
+
+    def __init__(self, mode: str, block: int):
+        if mode not in _WIRE_DTYPES:
+            raise ValueError(
+                f"collective_wire_dtype must be one of {_WIRE_DTYPES}, "
+                f"got {mode!r}")
+        self.mode = mode
+        self.block = max(1, int(block))
+        self.saved = 0
+
+    @property
+    def active(self) -> bool:
+        return self.mode != "exact"
+
+    def encode(self, arr):
+        arr = np.ascontiguousarray(arr)
+        if not self.active or arr.dtype.kind != "f" or arr.size == 0:
+            return arr
+        x32 = np.ascontiguousarray(
+            arr.astype(np.float32, copy=False).reshape(-1))
+        if not np.isfinite(x32).all():
+            # non-finite values don't survive either format (an inf
+            # poisons its whole int8 block's scale to NaN, NaN rounds
+            # to 0, negative-NaN bit patterns wrap the bf16 add): ship
+            # this chunk exact so a diverging gradient propagates
+            # faithfully instead of being silently masked
+            return arr
+        if self.mode == "bf16":
+            out = QuantChunk("bf16", arr.dtype.str, _bf16_encode(x32))
+        else:
+            idx, counts = _q8_block_counts(x32.size, self.block)
+            absmax = np.maximum.reduceat(np.abs(x32), idx)
+            scales = (absmax / 127.0).astype(np.float32)
+            # an all-zero block quantizes through scale 1 (q is all 0);
+            # the stored scale keeps the true value so decode stays 0
+            safe = np.where(scales > 0, scales, np.float32(1.0))
+            q = np.clip(np.rint(x32 / np.repeat(safe, counts)),
+                        -127, 127).astype(np.int8)
+            out = QuantChunk("int8-blockscale", arr.dtype.str, q, scales)
+        self.saved += max(0, int(arr.nbytes) - out.nbytes)
+        return out
+
+    def decode(self, payload) -> np.ndarray:
+        if not isinstance(payload, QuantChunk):
+            return np.asarray(payload)
+        if payload.mode == "bf16":
+            x32 = _bf16_decode(payload.q)
+        else:
+            _idx, counts = _q8_block_counts(payload.q.size, self.block)
+            safe = np.where(payload.scales > 0, payload.scales,
+                            np.float32(1.0))
+            x32 = payload.q.astype(np.float32) * np.repeat(safe, counts)
+        return x32.astype(np.dtype(payload.dtype), copy=False)
+
+    def roundtrip(self, arr: np.ndarray) -> np.ndarray:
+        """encode -> decode without sending: a segment's OWNER must end
+        up holding exactly the bytes every receiver will decode, or the
+        ranks diverge bit-wise."""
+        if not self.active:
+            return arr
+        return self.decode(self.encode(arr))
+
+
+def _make_codec() -> _WireCodec:
+    return _WireCodec(CONFIG.collective_wire_dtype,
+                      CONFIG.collective_quant_block_elems)
+
+
+def _observe_quant(codec: Optional[_WireCodec], op: str,
+                   group: str) -> None:
+    if codec is not None and codec.saved:
+        telemetry.counter_inc(M_COLL_QUANT_SAVED, float(codec.saved),
+                              (("group", group), ("op", op)))
+
+
+# ------------------------------------------------- algorithm selection
+
+_ALGO_CHOICES = ("auto", "ring", "tree", "hierarchical", "star")
+
+# which schedules each op can run; a forced/selected algo outside the
+# mask degrades to the op's bandwidth schedule (barrier has no payload,
+# so topology never matters to it)
+_ALGO_CAPS = {
+    "allreduce": ("ring", "tree", "hierarchical", "star"),
+    "reducescatter": ("ring", "hierarchical", "star"),
+    "allgather": ("ring", "hierarchical", "star"),
+    "broadcast": ("tree", "hierarchical", "star"),
+    "barrier": ("tree", "star"),
+}
+
+
+def _select_schedule(op: str, nbytes: int, world: int, nodes: int,
+                     dtype) -> str:
+    """The size x topology x dtype selection table. Pure function of
+    its arguments plus CONFIG (``collective_algo`` forces a schedule,
+    ``collective_tree_threshold_bytes`` and
+    ``collective_hierarchical_threshold_bytes`` set the crossovers).
+
+    - latency-bound sizes (below the tree threshold) -> binomial tree;
+    - multi-node topologies with co-located ranks (world > nodes > 1)
+      and bandwidth-bound sizes -> hierarchical two-level (the
+      threshold halves for float payloads when a quantized wire dtype
+      is configured: cheaper inter-node bytes amortize the intra-node
+      staging hops sooner);
+    - everything else -> flat ring (broadcast's bandwidth schedule is
+      the chunk-pipelined tree).
+    """
+    caps = _ALGO_CAPS[op]
+    fallback = "ring" if "ring" in caps else "tree"
+    forced = CONFIG.collective_algo
+    if forced != "auto":
+        if forced not in _ALGO_CHOICES:
+            raise ValueError(
+                f"collective_algo must be one of {_ALGO_CHOICES}, "
+                f"got {forced!r}")
+        return forced if forced in caps else fallback
+    if op == "barrier":
+        return "tree"
+    multi_node = nodes > 1 and world > nodes
+    if op in ("allgather", "broadcast"):
+        # topology-only: per-rank payload sizes may differ (allgather)
+        # or be unknown off-source (broadcast), and every rank MUST
+        # derive the same schedule from the same shared data — a
+        # size-keyed rule would let ranks diverge and deadlock
+        return "hierarchical" if multi_node else fallback
+    if nbytes < CONFIG.collective_tree_threshold_bytes and "tree" in caps:
+        return "tree"
+    if "hierarchical" in caps and multi_node:
+        threshold = CONFIG.collective_hierarchical_threshold_bytes
+        if (CONFIG.collective_wire_dtype != "exact"
+                and getattr(dtype, "kind", "") == "f"):
+            threshold //= 2
+        if nbytes >= threshold:
+            return "hierarchical"
+    return fallback
 
 
 class _CoordinatorImpl:
@@ -235,6 +467,36 @@ class _GroupState:
         # ranks derive this from the same exchanged data, so the whole
         # group agrees on the schedule)
         self.use_p2p = all(ep is not None for ep in endpoints)
+        # ------ topology: endpoints carry node identity (endpoint[0] is
+        # the owning node's id), so every rank derives the SAME node
+        # grouping from the same exchanged data — the hierarchical
+        # schedules route on it with no extra control-plane round trip
+        self.nodes: List[Any] = []            # node ids, first-rank order
+        self.node_ranks: Dict[Any, List[int]] = {}
+        if self.use_p2p:
+            for r, ep in enumerate(endpoints):
+                nid = ep[0]
+                if nid not in self.node_ranks:
+                    self.nodes.append(nid)
+                    self.node_ranks[nid] = []
+                self.node_ranks[nid].append(r)
+        self.n_nodes = len(self.nodes) if self.use_p2p else 1
+        if self.use_p2p:
+            my_node = endpoints[rank][0]
+            self.local_ranks = self.node_ranks[my_node]   # sorted (scan)
+            self.leader = self.local_ranks[0]
+            self.leaders = [self.node_ranks[nid][0] for nid in self.nodes]
+        else:
+            self.local_ranks = [rank]
+            self.leader = rank
+            self.leaders = [rank]
+        # node blocks are contiguous iff concatenating each node's ranks
+        # in node order counts 0..w-1 — the precondition for the
+        # hierarchical reduce-scatter's per-node segment bounds
+        self.node_blocks_contiguous = (
+            self.use_p2p
+            and sum((self.node_ranks[nid] for nid in self.nodes), [])
+            == list(range(world_size)))
         self.seq = 0
         # p2p sequence counters keyed by (peer_rank, tag)
         self.send_seq: Dict[tuple, int] = {}
@@ -247,6 +509,23 @@ class _GroupState:
 
     def key(self, seq: int) -> tuple:
         return (self.name, self.epoch, seq)
+
+
+class _SubState:
+    """A sub-group view the ring/tree schedule helpers run on unchanged:
+    ``members`` (global ranks, same order on every rank — derived from
+    the shared endpoint exchange) are remapped to 0..len-1. Used for the
+    per-node gang and the leaders-only ring of hierarchical schedules;
+    key disambiguation is the caller's job (distinct key prefixes per
+    phase, and phase-1/3 messages only ever travel between co-located
+    ranks, so equal local indices on different nodes cannot collide)."""
+
+    def __init__(self, state: _GroupState, members: List[int]):
+        self.name = state.name
+        self.members = members
+        self.world_size = len(members)
+        self.rank = members.index(state.rank)
+        self.endpoints = [state.endpoints[g] for g in members]
 
 
 # Per-process registry (module-global like the reference's GroupManager,
@@ -377,7 +656,14 @@ def _state(group_name: str) -> _GroupState:
 
 
 def _to_numpy(tensor) -> np.ndarray:
-    return np.asarray(tensor)
+    """Ingest a caller tensor as a C-CONTIGUOUS ndarray. The schedules
+    ship zero-copy views of this array: pickle-5 only exports
+    C-contiguous buffers out-of-band, so a transposed/strided input
+    would silently fall back to an in-band copy whose byte order no
+    longer matches the flat C-order reshape the receivers perform.
+    ``ascontiguousarray`` is a no-copy view for already-contiguous
+    input (the common case)."""
+    return np.ascontiguousarray(np.asarray(tensor))
 
 
 def _deadline(timeout: Optional[float]) -> float:
@@ -420,57 +706,75 @@ def _send(state: _GroupState, dst_rank: int, key: tuple, payload,
                         group=state.name, op=op)
 
 
-def _ring_reduce_scatter(state: _GroupState, buf: np.ndarray,
+def _ring_reduce_scatter(state, buf: np.ndarray,
                          bounds: List[int], op: str, key: tuple,
-                         deadline: float, opname: str) -> None:
+                         deadline: float, opname: str,
+                         codec: Optional[_WireCodec] = None) -> None:
     """In-place ring reduce-scatter over ``buf`` segments ``bounds``;
-    on return segment ``rank`` holds the full reduction."""
+    on return segment ``rank`` holds the full reduction. With a
+    ``codec`` every hop is encoded before the send and decoded before
+    the reduce (dequantize -> reduce -> requantize: the reduce itself
+    always runs in the original dtype, in ring order — deterministic)."""
     w, r = state.world_size, state.rank
     right = (r + 1) % w
     ce = _chunk_elems(buf.dtype)
     binop = _BINARY[op]
+    enc = codec.encode if codec is not None else (lambda x: x)
+    dec = codec.decode if codec is not None else np.asarray
 
     def chunks(seg: int) -> List[Tuple[int, int]]:
         return _chunk_ranges(bounds[seg], bounds[seg + 1], ce)
 
     first = (r - 1) % w
     for ci, (a, b) in enumerate(chunks(first)):
-        _send(state, right, key + ("rs", first, ci), buf[a:b], opname)
+        _send(state, right, key + ("rs", first, ci), enc(buf[a:b]), opname)
     for s in range(w - 1):
         seg = (r - 2 - s) % w
         for ci, (a, b) in enumerate(chunks(seg)):
             data = coll_transport.wait(key + ("rs", seg, ci), deadline)
             view = buf[a:b]
-            binop(view, np.asarray(data), out=view)
+            binop(view, dec(data), out=view)
             if s < w - 2:
                 # forward the just-reduced chunk while the next chunk
                 # of this segment is still in flight (pipelining)
-                _send(state, right, key + ("rs", seg, ci), view, opname)
+                _send(state, right, key + ("rs", seg, ci), enc(view),
+                      opname)
 
 
-def _ring_allgather_segments(state: _GroupState, buf: np.ndarray,
+def _ring_allgather_segments(state, buf: np.ndarray,
                              bounds: List[int], key: tuple,
-                             deadline: float, opname: str) -> None:
+                             deadline: float, opname: str,
+                             codec: Optional[_WireCodec] = None) -> None:
     """Ring allgather of ``buf`` segments: each rank starts with its own
     segment final (post reduce-scatter) and circulates; on return every
-    segment of ``buf`` is final."""
+    segment of ``buf`` is final. With a ``codec`` each segment is
+    encoded ONCE by its owner, forwarded verbatim, and the owner writes
+    the encode->decode roundtrip back into its own segment — so every
+    rank decodes (and returns) bit-identical bytes."""
     w, r = state.world_size, state.rank
     right = (r + 1) % w
     ce = _chunk_elems(buf.dtype)
+    dec = codec.decode if codec is not None else np.asarray
 
     def chunks(seg: int) -> List[Tuple[int, int]]:
         return _chunk_ranges(bounds[seg], bounds[seg + 1], ce)
 
     for ci, (a, b) in enumerate(chunks(r)):
-        _send(state, right, key + ("ag", r, ci), buf[a:b], opname)
+        if codec is not None and codec.active:
+            enc = codec.encode(buf[a:b])
+            _send(state, right, key + ("ag", r, ci), enc, opname)
+            buf[a:b] = codec.decode(enc)
+        else:
+            _send(state, right, key + ("ag", r, ci), buf[a:b], opname)
     for s in range(w - 1):
         seg = (r - 1 - s) % w
         for ci, (a, b) in enumerate(chunks(seg)):
             data = coll_transport.wait(key + ("ag", seg, ci), deadline)
             if s < w - 2:
-                # forward the received (zero-copy) view untouched
+                # forward the received (zero-copy) payload untouched —
+                # quantized segments are never re-encoded in flight
                 _send(state, right, key + ("ag", seg, ci), data, opname)
-            buf[a:b] = np.asarray(data)
+            buf[a:b] = dec(data)
 
 
 # --------------------------------------------------------- tree schedules
@@ -566,25 +870,213 @@ def _tree_bcast_chunked(state: _GroupState, value: Optional[np.ndarray],
     return buf.reshape(tuple(shape))
 
 
+# -------------------------------------------------- hierarchical schedules
+#
+# Two-level topology-aware schedules ("The Big Send-off" intra-node ->
+# inter-node shape): ranks are grouped by the node id their endpoint
+# carries, the lowest rank on each node is its leader, and only leaders
+# speak across nodes. On an m-node group with k ranks per node the
+# inter-node traffic of an allreduce drops from a flat ring's ~2x size
+# per CROSSING EDGE (of which there are m) to ~2·(m-1)/m·size per
+# LEADER — i.e. ~1/k of the total cross-wire bytes — and the intra-node
+# staging hops ride the same-host fast path. The optional wire codec
+# applies ONLY to the leader-ring hops of reductions.
+
+def _hier_allreduce(state: _GroupState, buf: np.ndarray, op: str,
+                    key: tuple, deadline: float, opname: str,
+                    codec: Optional[_WireCodec]) -> np.ndarray:
+    """allreduce = intra-node binomial reduce to the leader ->
+    leaders-only ring allreduce (codec on the hops) -> intra-node
+    binomial broadcast — fused per OUTER CHUNK so the three phases
+    pipeline: while the leaders run the inter-node ring on chunk k,
+    chunk k+1 is already climbing the local tree and chunk k-1 is
+    fanning back out (sends are fire-and-forget, so a member's phase-1
+    send of one chunk never waits on the ring). Serial critical path is
+    ~one phase's bytes, not the sum of all three. Returns the flat
+    result (aliasing ``buf`` on leaders)."""
+    local = _SubState(state, state.local_ranks)
+    lv, lw = local.rank, local.world_size
+    parent, children = _tree_parent_children(lv, lw)
+    is_leader = parent is None
+    leaders = (_SubState(state, state.leaders)
+               if is_leader and state.n_nodes > 1 else None)
+    ranges = _chunk_ranges(0, buf.size, _chunk_elems(buf.dtype))
+    binop = _BINARY[op]
+    out = buf if is_leader else np.empty_like(buf)
+    for ci, (a, b) in enumerate(ranges):
+        view = buf[a:b]
+        # phase 1: this chunk climbs the local binomial tree (children
+        # reduce into us, we pass the partial up)
+        for c in children:
+            data = coll_transport.wait(key + ("hl", ci, c), deadline)
+            binop(view, np.asarray(data), out=view)
+        if not is_leader:
+            _send(state, local.members[parent], key + ("hl", ci, lv),
+                  view, opname)
+            continue
+        # phase 2 (leader): inter-node ring allreduce of this chunk
+        if leaders is not None:
+            m = leaders.world_size
+            cb = [a + (i * (b - a)) // m for i in range(m + 1)]
+            _ring_reduce_scatter(leaders, buf, cb, op, key + ("hx", ci),
+                                 deadline, opname, codec=codec)
+            _ring_allgather_segments(leaders, buf, cb, key + ("hx", ci),
+                                     deadline, opname, codec=codec)
+        # phase 3 (leader): fan the finished chunk down the local tree
+        for c in children:
+            _send(state, local.members[c], key + ("hb", ci, c), view,
+                  opname)
+    if not is_leader:
+        # phase 3: chunks arrive from the parent, forward to our
+        # subtree, assemble the result
+        for ci, (a, b) in enumerate(ranges):
+            data = coll_transport.wait(key + ("hb", ci, lv), deadline)
+            for c in children:
+                _send(state, local.members[c], key + ("hb", ci, c),
+                      data, opname)
+            out[a:b] = np.asarray(data)
+    return out
+
+
+def _hier_reducescatter(state: _GroupState, buf: np.ndarray, op: str,
+                        seg_elems: int, key: tuple, deadline: float,
+                        opname: str,
+                        codec: Optional[_WireCodec]) -> np.ndarray:
+    """reducescatter = intra-node tree reduce to the leader -> leaders
+    ring reduce-scatter over PER-NODE segment blocks (codec on the
+    hops) -> leader hands each co-located rank its slice. Requires
+    ``state.node_blocks_contiguous`` (the selector's caller degrades to
+    the flat ring otherwise). Returns this rank's flat slice."""
+    r = state.rank
+    local = _SubState(state, state.local_ranks)
+    total = _tree_reduce(local, buf, op, key + ("hl",), deadline, opname)
+    if total is not None:
+        if state.n_nodes > 1:
+            leaders = _SubState(state, state.leaders)
+            # node j's block spans its member ranks' slices (contiguous
+            # by precondition, in leader-ring segment order)
+            bounds = [state.node_ranks[nid][0] * seg_elems
+                      for nid in state.nodes]
+            bounds.append(state.world_size * seg_elems)
+            _ring_reduce_scatter(leaders, total, bounds, op,
+                                 key + ("hx",), deadline, opname,
+                                 codec=codec)
+        for peer in state.local_ranks[1:]:
+            a = peer * seg_elems
+            _send(state, peer, key + ("hs", peer),
+                  total[a:a + seg_elems], opname)
+        return total[r * seg_elems:(r + 1) * seg_elems]
+    data = coll_transport.wait(key + ("hs", r), deadline)
+    return np.asarray(data).reshape(-1)
+
+
+def _hier_allgather(state: _GroupState, arr: np.ndarray, key: tuple,
+                    deadline: float, opname: str) -> List[np.ndarray]:
+    """allgather = co-located ranks hand their arrays to the leader ->
+    leaders ring-allgather per-node BUNDLES (one mailbox message per
+    node per hop instead of one per rank) -> leader fans the full part
+    list back out. Caller bytes are relayed verbatim (never quantized)."""
+    w, r = state.world_size, state.rank
+    if r != state.leader:
+        _send(state, state.leader, key + ("hga", r), arr, opname)
+        parts = coll_transport.wait(key + ("hgb", r), deadline)
+        return [np.asarray(p) for p in parts]
+    out: List[Any] = [None] * w
+    out[r] = arr
+    for peer in state.local_ranks[1:]:
+        out[peer] = np.asarray(
+            coll_transport.wait(key + ("hga", peer), deadline))
+    if state.n_nodes > 1:
+        leaders = _SubState(state, state.leaders)
+        lr = leaders.rank
+        m = leaders.world_size
+        right = (lr + 1) % m
+        my_nid = state.nodes[lr]
+        bundle = tuple(out[g] for g in state.node_ranks[my_nid])
+        _send(state, state.leaders[right], key + ("hgx", lr), bundle,
+              opname)
+        for s in range(m - 1):
+            src = (lr - 1 - s) % m
+            bundle = coll_transport.wait(key + ("hgx", src), deadline)
+            if s < m - 2:
+                _send(state, state.leaders[right], key + ("hgx", src),
+                      bundle, opname)
+            for g, part in zip(state.node_ranks[state.nodes[src]], bundle):
+                out[g] = np.asarray(part)
+    for peer in state.local_ranks[1:]:
+        _send(state, peer, key + ("hgb", peer), tuple(out), opname)
+    return [np.asarray(p) for p in out]
+
+
+def _hier_broadcast(state: _GroupState, value: Optional[np.ndarray],
+                    src_rank: int, key: tuple, deadline: float,
+                    opname: str) -> np.ndarray:
+    """broadcast = source -> its node's leader (one same-host hop) ->
+    chunk-pipelined binomial tree over the LEADERS (every hop of it is
+    a genuine cross-node transfer, m-1 of them — the minimum) ->
+    chunk-pipelined tree inside each node. Bytes relayed verbatim."""
+    r = state.rank
+    src_node = state.endpoints[src_rank][0]
+    src_leader = state.node_ranks[src_node][0]
+    if r == src_rank and r != src_leader:
+        _send(state, src_leader, key + ("hb0",), value, opname)
+    data: Optional[np.ndarray] = value if r == src_rank else None
+    if r in state.leaders:
+        if r == src_leader and r != src_rank:
+            data = np.asarray(
+                coll_transport.wait(key + ("hb0",), deadline))
+        leaders = _SubState(state, state.leaders)
+        data = _tree_bcast_chunked(leaders, data,
+                                   state.leaders.index(src_leader),
+                                   key + ("hx",), deadline, opname)
+    local = _SubState(state, state.local_ranks)
+    out = _tree_bcast_chunked(local, data if r == state.leader else None,
+                              0, key + ("hb",), deadline, opname)
+    return np.asarray(out)
+
+
 # ------------------------------------------------------------- public API
+
+def _pick(state: _GroupState, op: str, nbytes: int, dtype) -> str:
+    """Resolve the schedule for one call and record the choice (the
+    counter must reflect the schedule that actually RUNS, so any
+    topology-based demotion happens before recording)."""
+    if state.world_size == 1:
+        algo = "local"
+    elif not state.use_p2p:
+        algo = "star"
+    else:
+        algo = _select_schedule(op, nbytes, state.world_size,
+                                state.n_nodes, dtype)
+        if (algo == "hierarchical" and op == "reducescatter"
+                and not state.node_blocks_contiguous):
+            # per-node segment bounds need each node's ranks to span a
+            # contiguous rank range; interleaved placements run the
+            # flat ring
+            algo = "ring"
+    _observe_algo(op, algo)
+    return algo
+
 
 def allreduce(tensor, group_name: str = "default", op: str = SUM,
               timeout: Optional[float] = None):
     """All-reduce; returns the reduced array (reference mutates in place —
-    functional style here, jax arrays are immutable). Ring reduce-scatter
-    + allgather above ``collective_tree_threshold_bytes``, binomial tree
-    below it; every rank returns bit-identical bytes."""
+    functional style here, jax arrays are immutable). Schedule per the
+    selection table: binomial tree (latency-bound), flat ring, or
+    hierarchical two-level (multi-node; optionally block-quantized
+    inter-node). Every rank returns bit-identical bytes."""
     state = _state(group_name)
     arr = _to_numpy(tensor)
     t0 = time.monotonic()
     seq = state.next_seq()
-    if state.world_size == 1:
+    algo = _pick(state, "allreduce", arr.nbytes, arr.dtype)
+    if algo == "local":
         result = np.array(arr)
-    elif not state.use_p2p:
+    elif algo == "star":
         result = np.asarray(_coord(state.coordinator, "rendezvous",
                                    state.key(seq), state.rank, arr, op,
                                    _timeout_s(timeout)))
-    elif arr.nbytes < CONFIG.collective_tree_threshold_bytes:
+    elif algo == "tree":
         key, deadline = state.key(seq), _deadline(timeout)
         total = _tree_reduce(state, arr, op, key, deadline, "allreduce")
         result = _tree_bcast_small(state, total, 0, key, deadline,
@@ -593,10 +1085,19 @@ def allreduce(tensor, group_name: str = "default", op: str = SUM,
         # caller may mutate it the moment we return, so the zero-copy
         # sends must have left this process first
         coll_transport.flush()
+    elif algo == "hierarchical":
+        key, deadline = state.key(seq), _deadline(timeout)
+        codec = _make_codec()
+        buf = arr.reshape(-1).copy()
+        out = _hier_allreduce(state, buf, op, key, deadline,
+                              "allreduce", codec)
+        # leaders fan out zero-copy views of the result they return
+        coll_transport.flush()
+        _observe_quant(codec, "allreduce", group_name)
+        result = out.reshape(arr.shape)
     else:
         key, deadline = state.key(seq), _deadline(timeout)
-        flat = np.ascontiguousarray(arr).reshape(-1)
-        buf = flat.copy()
+        buf = arr.reshape(-1).copy()
         n = buf.size
         w = state.world_size
         bounds = [(i * n) // w for i in range(w + 1)]
@@ -621,12 +1122,19 @@ def allgather(tensor, group_name: str = "default",
     t0 = time.monotonic()
     seq = state.next_seq()
     w, r = state.world_size, state.rank
-    if w == 1:
+    algo = _pick(state, "allgather", arr.nbytes, arr.dtype)
+    if algo == "local":
         parts: List[np.ndarray] = [np.array(arr)]
-    elif not state.use_p2p:
+    elif algo == "star":
         parts = [np.asarray(p) for p in _coord(
             state.coordinator, "rendezvous", state.key(seq), r, arr,
             None, _timeout_s(timeout))]
+    elif algo == "hierarchical":
+        key, deadline = state.key(seq), _deadline(timeout)
+        parts = _hier_allgather(state, arr, key, deadline, "allgather")
+        # the caller's own ``arr`` (and, on leaders, the returned parts)
+        # went out zero-copy — flush the link before they can be mutated
+        coll_transport.flush()
     else:
         key, deadline = state.key(seq), _deadline(timeout)
         out: List[Any] = [None] * w
@@ -662,18 +1170,30 @@ def reducescatter(tensor, group_name: str = "default", op: str = SUM,
             f"reducescatter: leading dim {arr.shape[:1]} not divisible "
             f"by world size {w}")
     rows = arr.shape[0] // w
-    if w == 1:
+    algo = _pick(state, "reducescatter", arr.nbytes, arr.dtype)
+    if algo == "local":
         result = np.array(arr)
-    elif not state.use_p2p:
+    elif algo == "star":
         reduced = np.asarray(_coord(state.coordinator, "rendezvous",
                                     state.key(seq), r, arr, op,
                                     _timeout_s(timeout)))
         result = reduced[r * rows:(r + 1) * rows]
+    elif algo == "hierarchical":
+        key, deadline = state.key(seq), _deadline(timeout)
+        codec = _make_codec()
+        buf = arr.reshape(-1).copy()
+        seg_elems = rows * (buf.size // arr.shape[0])
+        out = _hier_reducescatter(state, buf, op, seg_elems, key,
+                                  deadline, "reducescatter", codec)
+        # leaders ship zero-copy slices of the buffer they keep a slice
+        # of — flush before the caller can mutate the result
+        coll_transport.flush()
+        _observe_quant(codec, "reducescatter", group_name)
+        result = out.reshape((rows,) + arr.shape[1:]).copy()
     else:
         key, deadline = state.key(seq), _deadline(timeout)
-        flat = np.ascontiguousarray(arr).reshape(-1)
-        buf = flat.copy()
-        seg_elems = rows * (flat.size // arr.shape[0])
+        buf = arr.reshape(-1).copy()
+        seg_elems = rows * (buf.size // arr.shape[0])
         bounds = [i * seg_elems for i in range(w + 1)]
         _ring_reduce_scatter(state, buf, bounds, op, key, deadline,
                              "reducescatter")
@@ -693,13 +1213,20 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
     t0 = time.monotonic()
     seq = state.next_seq()
     is_src = state.rank == src_rank
-    if state.world_size == 1:
+    algo = _pick(state, "broadcast", arr.nbytes if is_src else 0,
+                 arr.dtype)
+    if algo == "local":
         result = np.array(arr)
-    elif not state.use_p2p:
+    elif algo == "star":
         parts = _coord(state.coordinator, "rendezvous", state.key(seq),
                        state.rank, arr if is_src else None, None,
                        _timeout_s(timeout))
         result = np.asarray(parts[src_rank])
+    elif algo == "hierarchical":
+        result = _hier_broadcast(state, arr if is_src else None,
+                                 src_rank, state.key(seq),
+                                 _deadline(timeout), "broadcast")
+        coll_transport.flush()
     else:
         result = _tree_bcast_chunked(state, arr if is_src else None,
                                      src_rank, state.key(seq),
@@ -719,9 +1246,10 @@ def barrier(group_name: str = "default",
     state = _state(group_name)
     t0 = time.monotonic()
     seq = state.next_seq()
-    if state.world_size == 1:
+    algo = _pick(state, "barrier", 0, np.dtype(np.uint8))
+    if algo == "local":
         pass
-    elif not state.use_p2p:
+    elif algo == "star":
         _coord(state.coordinator, "rendezvous", state.key(seq),
                state.rank, None, None, _timeout_s(timeout))
     else:

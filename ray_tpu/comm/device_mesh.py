@@ -65,6 +65,19 @@ class SPMDWorkerBase(CollectiveActorMixin):
         return col.broadcast(tensor, src_rank=src_rank,
                              group_name=self._mesh_group_name())
 
+    def mesh_reducescatter(self, tensor, op: str = "sum"):
+        """Reduce across hosts, keep this host's 1/world slice (the
+        DCN half of a cross-slice gradient shard: each host feeds its
+        slice of the reduced update to its own chips)."""
+        from . import collective as col
+        return col.reducescatter(tensor, op=op,
+                                 group_name=self._mesh_group_name())
+
+    def mesh_allgather(self, tensor):
+        """Gather every host's array (rank order) over the host plane."""
+        from . import collective as col
+        return col.allgather(tensor, group_name=self._mesh_group_name())
+
     def mesh_barrier(self) -> None:
         from . import collective as col
         col.barrier(group_name=self._mesh_group_name())

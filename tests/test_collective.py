@@ -429,10 +429,21 @@ def test_mesh_group_collective(rtpu_init):
             self.mesh_barrier()
             return float(out[0]), int(out.shape[0])
 
+        def shard_roundtrip(self, n):
+            # reducescatter my slice, then allgather the slices back:
+            # the reassembled tensor must equal the full allreduce
+            x = np.full((2, n), float(self.mesh_rank + 1), np.float32)
+            mine = self.mesh_reducescatter(x)
+            parts = self.mesh_allgather(mine)
+            full = np.concatenate(parts, axis=0)
+            return float(full.min()), float(full.max()), full.shape
+
     group = mesh_group(HostC, num_hosts=2,
                        resources_per_host={"CPU": 1},
                        strategy="PACK", collective_group="meshg")
     assert group.run("sync", 50_000) == [(3.0, 50_000)] * 2
+    # sum over ranks {1, 2} = 3.0 everywhere after scatter + gather
+    assert group.run("shard_roundtrip", 1000) == [(3.0, 3.0, (2, 1000))] * 2
     group.shutdown()
 
 
@@ -455,6 +466,410 @@ def test_group_init_on_saturated_cluster(rtpu_init):
     outs = ray_tpu.get([m.ar.remote([1.0]) for m in members], timeout=60)
     for arr in outs:
         np.testing.assert_allclose(arr, [4.0])
+
+
+def test_select_schedule_table():
+    """The size x topology x dtype selection table (ISSUE 8): exact
+    expectations per regime, forced overrides degrade to each op's
+    capability set, and ops whose per-rank payload sizes can legally
+    differ (allgather) or be unknown off-source (broadcast) must select
+    on topology ONLY — a size-keyed rule would let ranks diverge into
+    different schedules and deadlock."""
+    import numpy as np
+
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.comm.collective import _select_schedule
+
+    f4, i4 = np.dtype(np.float32), np.dtype(np.int32)
+    tree_thr = CONFIG.collective_tree_threshold_bytes
+    hier_thr = CONFIG.collective_hierarchical_threshold_bytes
+    # latency-bound -> tree; bandwidth-bound -> ring; multi-node with
+    # co-located ranks -> hierarchical (never when world == nodes)
+    assert _select_schedule("allreduce", tree_thr - 1, 4, 1, f4) == "tree"
+    assert _select_schedule("allreduce", hier_thr, 4, 1, f4) == "ring"
+    assert _select_schedule("allreduce", hier_thr, 4, 2, f4) == "hierarchical"
+    assert _select_schedule("allreduce", hier_thr - 1, 4, 2, f4) == "ring"
+    assert _select_schedule("allreduce", hier_thr, 4, 4, f4) == "ring"
+    assert _select_schedule("reducescatter", hier_thr, 4, 2, f4) == \
+        "hierarchical"
+    assert _select_schedule("barrier", 0, 4, 2, np.dtype(np.uint8)) == "tree"
+    # topology-only ops: same answer whatever nbytes says
+    for nb in (0, 10, 10 << 20):
+        assert _select_schedule("allgather", nb, 4, 2, f4) == "hierarchical"
+        assert _select_schedule("broadcast", nb, 4, 2, f4) == "hierarchical"
+        assert _select_schedule("allgather", nb, 4, 1, f4) == "ring"
+        assert _select_schedule("broadcast", nb, 4, 1, f4) == "tree"
+    orig_algo = CONFIG.collective_algo
+    orig_wire = CONFIG.collective_wire_dtype
+    try:
+        # a quantized wire dtype halves the hierarchical threshold for
+        # float reductions only (cheaper inter-node bytes amortize the
+        # staging hops sooner); integer payloads are never quantized
+        CONFIG._values["collective_wire_dtype"] = "int8-blockscale"
+        assert _select_schedule("allreduce", hier_thr // 2, 4, 2, f4) == \
+            "hierarchical"
+        assert _select_schedule("allreduce", hier_thr // 2, 4, 2, i4) == \
+            "ring"
+        CONFIG._values["collective_wire_dtype"] = "exact"
+        # forced schedules clamp to each op's capability set
+        CONFIG._values["collective_algo"] = "ring"
+        assert _select_schedule("allreduce", hier_thr, 4, 2, f4) == "ring"
+        assert _select_schedule("broadcast", hier_thr, 4, 2, f4) == "tree"
+        CONFIG._values["collective_algo"] = "hierarchical"
+        assert _select_schedule("barrier", 0, 4, 2, f4) == "tree"
+        assert _select_schedule("allreduce", 10, 4, 2, f4) == "hierarchical"
+        CONFIG._values["collective_algo"] = "bogus"
+        import pytest
+        with pytest.raises(ValueError):
+            _select_schedule("allreduce", 10, 4, 2, f4)
+    finally:
+        CONFIG._values["collective_algo"] = orig_algo
+        CONFIG._values["collective_wire_dtype"] = orig_wire
+
+
+def test_wire_codec_numerics():
+    """Block-quantized wire format units: bf16 relative error is
+    bounded by the 8-bit mantissa, int8-blockscale absolute error by
+    half a block scale, dtypes are restored, integers and exact mode
+    pass through untouched, and encode->decode is deterministic (the
+    bit-identical-ranks property rides on it)."""
+    import numpy as np
+
+    from ray_tpu.comm.collective import QuantChunk, _WireCodec
+
+    rng = np.random.RandomState(7)
+    x = (rng.randn(100_000) * 50).astype(np.float32)
+    q8 = _WireCodec("int8-blockscale", 256)
+    enc = q8.encode(x)
+    assert isinstance(enc, QuantChunk)
+    # ~3.9x wire reduction: 1 int8 + 1/256 float32 scale per float32
+    assert enc.nbytes < x.nbytes / 3.5
+    dec = q8.decode(enc)
+    assert dec.dtype == np.float32
+    # per-block bound: |err| <= blockmax/127/2; globally <= absmax/254
+    assert np.abs(dec - x).max() <= np.abs(x).max() / 254 + 1e-6
+    assert np.array_equal(q8.decode(enc), dec)          # deterministic
+    assert q8.saved == x.nbytes - enc.nbytes
+
+    bf = _WireCodec("bf16", 256)
+    enc16 = bf.encode(x)
+    assert enc16.nbytes == x.nbytes // 2
+    dec16 = bf.decode(enc16)
+    rel = np.abs(dec16 - x) / np.maximum(np.abs(x), 1e-9)
+    assert rel.max() <= 2.0 ** -8
+
+    # trailing partial block + all-zero blocks decode exactly
+    z = np.zeros(300, np.float32)
+    assert np.array_equal(q8.decode(q8.encode(z)), z)
+    tail = (rng.randn(300) * 3).astype(np.float32)
+    assert np.abs(q8.decode(q8.encode(tail)) - tail).max() <= \
+        np.abs(tail).max() / 254 + 1e-6
+
+    # float64 in -> float64 out (wire rides float32-derived payloads)
+    x64 = rng.randn(500)
+    assert q8.decode(q8.encode(x64)).dtype == np.float64
+    # integers and exact mode are identity (integer reductions must
+    # stay exact on every hop)
+    xi = np.arange(1000, dtype=np.int64)
+    assert q8.encode(xi) is not None
+    assert np.array_equal(q8.decode(q8.encode(xi)), xi)
+    assert not _WireCodec("exact", 256).active
+
+    # non-finite chunks bypass quantization entirely (an inf poisons
+    # its int8 block's scale, NaN rounds to 0, negative-NaN wraps the
+    # bf16 add): a diverging gradient must propagate faithfully
+    bad = np.asarray([1.0, np.inf, 2.0, np.nan, -np.inf], np.float32)
+    for codec in (q8, bf):
+        enc_bad = codec.encode(bad)
+        assert not isinstance(enc_bad, QuantChunk)
+        np.testing.assert_array_equal(codec.decode(enc_bad), bad)
+
+    import pytest
+    with pytest.raises(ValueError):
+        _WireCodec("fp4", 256)
+
+
+def test_strided_input_collectives(rtpu_init):
+    """Satellite regression: transposed / F-ordered (non-C-contiguous)
+    tensors handed to collectives must produce the same bytes as their
+    contiguous copies — ``_to_numpy`` forces C-contiguity before any
+    zero-copy view goes on the wire (pickle-5 only exports C-contiguous
+    buffers out-of-band; receivers reshape flat C-order)."""
+    import ray_tpu
+    from ray_tpu.comm import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class Strided(col.CollectiveActorMixin):
+        def ar_transposed(self, n):
+            rank = col.get_rank()
+            base = (np.arange(n, dtype=np.float32).reshape(4, n // 4)
+                    + rank)
+            t = base.T                      # non-contiguous view
+            assert not t.flags["C_CONTIGUOUS"]
+            return col.allreduce(t)
+
+        def ar_fortran(self, n):
+            rank = col.get_rank()
+            f = np.asfortranarray(
+                np.arange(n, dtype=np.float32).reshape(4, n // 4) + rank)
+            return col.allreduce(f)
+
+        def sendrecv_strided(self):
+            rank = col.get_rank()
+            arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+            if rank == 0:
+                col.send(arr.T, dst_rank=1)
+                return None
+            return col.recv(src_rank=0)
+
+    n = 400_000                            # 1.6 MB -> ring path
+    members = [Strided.remote() for _ in range(2)]
+    col.create_collective_group(members, 2, [0, 1])
+    want_t = sum((np.arange(n, dtype=np.float32).reshape(4, n // 4) + r)
+                 for r in range(2)).T
+    outs = ray_tpu.get([m.ar_transposed.remote(n) for m in members],
+                       timeout=60)
+    for out in outs:
+        assert out.shape == want_t.shape
+        np.testing.assert_array_equal(out, want_t)
+    outs = ray_tpu.get([m.ar_fortran.remote(n) for m in members],
+                       timeout=60)
+    for out in outs:
+        np.testing.assert_array_equal(out, want_t.T)
+    sr = ray_tpu.get([m.sendrecv_strided.remote() for m in members])
+    np.testing.assert_array_equal(
+        sr[1], np.arange(24, dtype=np.float32).reshape(4, 6).T)
+
+
+def _two_node_cluster():
+    """In-process 2-node cluster with rank-pinning resources: ranks 0/1
+    land on the head ("a"), ranks 2/3 on the second node ("b") — the
+    2-node x 2-rank topology every hierarchical test runs on."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2,
+                                      "resources": {"a": 4.0}})
+    cluster.add_node(num_cpus=2, resources={"b": 4.0})
+    ray_tpu.init(address=cluster)
+    return cluster
+
+
+def _make_hier_worker():
+    import hashlib
+
+    import ray_tpu
+    from ray_tpu._private import coll_transport
+    from ray_tpu.comm import collective as col
+
+    @ray_tpu.remote(num_cpus=0)
+    class Hier(col.CollectiveActorMixin):
+        def configure(self, algo="auto", wire="exact"):
+            from ray_tpu._private.config import CONFIG
+            CONFIG._values["collective_algo"] = algo
+            CONFIG._values["collective_wire_dtype"] = wire
+            return True
+
+        def topology(self):
+            st = col._groups()["default"]
+            return (st.n_nodes, st.leaders, st.local_ranks,
+                    st.node_blocks_contiguous)
+
+        def ar(self, n, op, dtype="<f4"):
+            rank = col.get_rank()
+            x = ((np.arange(n) % 13) + 1 + rank).astype(np.dtype(dtype))
+            before = coll_transport.stats()["sent_remote_bytes"]
+            out = col.allreduce(x, op=op)
+            remote = (coll_transport.stats()["sent_remote_bytes"]
+                      - before)
+            return (out, hashlib.sha256(out.tobytes()).hexdigest(),
+                    remote)
+
+        def rs(self, n):
+            rank = col.get_rank()
+            x = np.full((4, n), float(rank + 1), np.float32)
+            return col.reducescatter(x)
+
+        def gather(self, v):
+            return col.allgather(np.asarray(v, np.float32))
+
+        def bcast(self, v):
+            payload = (np.asarray(v, np.float32) if col.get_rank() == 1
+                       else np.zeros(len(v), np.float32))
+            return col.broadcast(payload, src_rank=1)
+
+        def algo_counts(self):
+            from ray_tpu._private import telemetry
+            out = {}
+            counters = telemetry.snapshot_local()["counters"]
+            for (name, tags), total in counters.items():
+                if name == "rtpu_collective_algo_total":
+                    out[dict(tags).get("algo"), dict(tags).get("op")] = \
+                        int(total)
+            return out
+
+    return Hier
+
+
+def _hier_group(Hier):
+    import ray_tpu
+    from ray_tpu.comm import collective as col
+
+    members = ([Hier.options(resources={"a": 1.0}).remote()
+                for _ in range(2)]
+               + [Hier.options(resources={"b": 1.0}).remote()
+                  for _ in range(2)])
+    ray_tpu.get([m.configure.remote() for m in members])
+    col.create_collective_group(members, 4, [0, 1, 2, 3])
+    return members
+
+
+def test_hierarchical_two_node_topology_and_ops():
+    """Hierarchical schedules on a 2-node x 2-rank cluster: topology is
+    derived from the endpoint exchange (2 nodes, leaders [0, 2],
+    contiguous blocks), every op is correct under auto selection (which
+    picks hierarchical for the bandwidth-bound sizes), and the
+    inter-node wire bytes of a hierarchical allreduce are LOWER than
+    the flat ring's on the same group — the point of the two-level
+    schedule."""
+    import ray_tpu
+    from ray_tpu.comm import collective as col
+
+    cluster = _two_node_cluster()
+    try:
+        Hier = _make_hier_worker()
+        members = _hier_group(Hier)
+        topos = ray_tpu.get([m.topology.remote() for m in members])
+        assert topos[0] == (2, [0, 2], [0, 1], True)
+        assert topos[2] == (2, [0, 2], [2, 3], True)
+
+        n = 262_144                    # 1 MB float32 >= hier threshold
+        want = sum(((np.arange(n) % 13) + 1 + r).astype(np.float32)
+                   for r in range(4))
+        outs = ray_tpu.get([m.ar.remote(n, col.SUM) for m in members],
+                           timeout=120)
+        digests = {d for _, d, _ in outs}
+        assert len(digests) == 1       # bit-identical on every rank
+        np.testing.assert_array_equal(outs[0][0], want)
+        hier_remote = sum(r for _, _, r in outs)
+        assert hier_remote > 0         # it DID cross the node plane
+
+        # the selector recorded hierarchical for this op
+        counts = ray_tpu.get(members[0].algo_counts.remote())
+        assert counts.get(("hierarchical", "allreduce"), 0) >= 1
+
+        # same call forced onto the flat ring: same bytes, more
+        # cross-node traffic (2 crossing edges x 2*(w-1)/w*size beats
+        # the leaders' 2 x 2*(m-1)/m*size at 2 ranks per node)
+        ray_tpu.get([m.configure.remote(algo="ring") for m in members])
+        outs_ring = ray_tpu.get([m.ar.remote(n, col.SUM)
+                                 for m in members], timeout=120)
+        assert {d for _, d, _ in outs_ring} == digests
+        ring_remote = sum(r for _, _, r in outs_ring)
+        assert hier_remote < ring_remote, (
+            f"hierarchical crossed {hier_remote}B vs flat ring's "
+            f"{ring_remote}B — the two-level schedule saved nothing")
+
+        ray_tpu.get([m.configure.remote() for m in members])
+        # reducescatter / allgather / broadcast correctness on the same
+        # topology (auto -> hierarchical for all three)
+        rs = ray_tpu.get([m.rs.remote(100_000) for m in members],
+                         timeout=120)
+        for part in rs:
+            assert part.shape == (1, 100_000)
+            np.testing.assert_array_equal(
+                part, np.full((1, 100_000), 10.0, np.float32))
+        gathered = ray_tpu.get(
+            [m.gather.remote([float(i), float(i)])
+             for i, m in enumerate(members)], timeout=120)
+        for parts in gathered:
+            np.testing.assert_array_equal(
+                np.concatenate(parts),
+                np.repeat(np.arange(4, dtype=np.float32), 2))
+        bc = ray_tpu.get([m.bcast.remote([7.0, 8.0, 9.0])
+                          for m in members], timeout=120)
+        for arr in bc:
+            np.testing.assert_array_equal(
+                arr, np.asarray([7.0, 8.0, 9.0], np.float32))
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_hierarchical_quantized_wire_numerics():
+    """The block-quantized inter-node wire format on the 2-node
+    topology: `exact` stays bit-exact (and is the shipped default),
+    bf16/int8-blockscale stay within their error bounds for every
+    reduce op, all ranks remain BIT-IDENTICAL to each other under
+    quantization (dequantize->reduce->requantize is deterministic and
+    the allgather phase circulates encoded segments verbatim), integer
+    payloads are never quantized, and int8 cuts the measured
+    inter-node bytes >= 2x vs exact."""
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.comm import collective as col
+
+    assert CONFIG.collective_wire_dtype == "exact"      # shipped default
+
+    cluster = _two_node_cluster()
+    try:
+        Hier = _make_hier_worker()
+        members = _hier_group(Hier)
+        n = 262_144
+        parts = [((np.arange(n) % 13) + 1 + r).astype(np.float32)
+                 for r in range(4)]
+        import functools
+        from ray_tpu.comm.collective import _BINARY
+
+        # exact hierarchical: bit-exact vs numpy for every op
+        for op in (col.SUM, col.PROD, col.MIN, col.MAX):
+            outs = ray_tpu.get([m.ar.remote(n, op) for m in members],
+                               timeout=120)
+            want = functools.reduce(_BINARY[op], parts)
+            for out, _d, _r in outs:
+                np.testing.assert_array_equal(out, want)
+
+        remote_exact = sum(
+            r for _, _, r in ray_tpu.get(
+                [m.ar.remote(n, col.SUM) for m in members], timeout=120))
+
+        for wire, factor in (
+                # bf16: 8-bit mantissa, one quantization per inter-node
+                # hop (m=2 -> <=2 events/element), on partial reductions
+                ("bf16", 2.0 ** -8 * 4),
+                # int8: |err| <= scale/2 = blockmax/254 per event
+                ("int8-blockscale", 4 / 254)):
+            ray_tpu.get([m.configure.remote(wire=wire) for m in members])
+            for op in (col.SUM, col.PROD, col.MIN, col.MAX):
+                outs = ray_tpu.get([m.ar.remote(n, op) for m in members],
+                                   timeout=120)
+                want = functools.reduce(_BINARY[op], parts)
+                # the bound scales with the op's own magnitude (PROD
+                # partials reach ~14^4; quantization error is relative
+                # to each block's max-abs)
+                tol = float(np.abs(want).max()) * factor
+                assert len({d for _, d, _ in outs}) == 1, \
+                    f"{wire}/{op}: ranks diverged bit-wise"
+                err = np.abs(outs[0][0] - want).max()
+                assert err <= tol, f"{wire}/{op}: err {err} > {tol}"
+            # integer dtypes bypass quantization entirely
+            outs = ray_tpu.get([m.ar.remote(n, col.SUM, "<i4")
+                                for m in members], timeout=120)
+            want_i = sum(((np.arange(n) % 13) + 1 + r).astype(np.int32)
+                         for r in range(4))
+            for out, _d, _r in outs:
+                np.testing.assert_array_equal(out, want_i)
+
+        remote_q8 = sum(
+            r for _, _, r in ray_tpu.get(
+                [m.ar.remote(n, col.SUM) for m in members], timeout=120))
+        assert remote_q8 * 2 <= remote_exact, (
+            f"int8-blockscale crossed {remote_q8}B vs exact's "
+            f"{remote_exact}B — less than the promised 2x reduction")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
 
 
 def test_destroy_and_recreate_group(rtpu_init):

@@ -460,6 +460,69 @@ def test_scheduling_with_delayed_heartbeats(tcp_cluster):
     assert len(alive) == 2          # slow heartbeats != dead
 
 
+def test_cross_node_hierarchical_collective(tcp_cluster):
+    """Hierarchical two-level allreduce across OS-isolated nodes: two
+    co-located ranks per node, so auto-selection picks the hierarchical
+    schedule, only the leaders' ring crosses the TCP wire, and the
+    measured inter-node bytes are LOWER than the flat ring's on the
+    same group; int8-blockscale then halves them again (>= 2x) at a
+    bounded max-abs error."""
+    from ray_tpu._private import coll_transport
+    from ray_tpu.comm import collective as col
+
+    tcp_cluster.add_node(num_cpus=2, resources={"side": 2.0})
+    _wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=0)
+    class Rank(col.CollectiveActorMixin):
+        def configure(self, algo="auto", wire="exact"):
+            from ray_tpu._private.config import CONFIG
+            CONFIG._values["collective_algo"] = algo
+            CONFIG._values["collective_wire_dtype"] = wire
+            return True
+
+        def n_nodes(self):
+            return col._groups()["default"].n_nodes
+
+        def big_allreduce(self, n):
+            rank = col.get_rank()
+            x = ((np.arange(n) % 13) + 1 + rank).astype(np.float32)
+            before = coll_transport.stats()["sent_remote_bytes"]
+            out = col.allreduce(x)
+            remote = (coll_transport.stats()["sent_remote_bytes"]
+                      - before)
+            return out[:8], float(np.abs(out).max()), remote
+
+    n = 1_048_576                       # 4 MB of float32
+    members = ([Rank.remote() for _ in range(2)]
+               + [Rank.options(resources={"side": 1.0}).remote()
+                  for _ in range(2)])
+    col.create_collective_group(members, 4, [0, 1, 2, 3])
+    assert ray_tpu.get(members[0].n_nodes.remote()) == 2
+
+    want = sum(((np.arange(n) % 13) + 1 + r).astype(np.float32)
+               for r in range(4))
+    remotes = {}
+    for algo, wire in (("auto", "exact"), ("ring", "exact"),
+                       ("auto", "int8-blockscale")):
+        ray_tpu.get([m.configure.remote(algo, wire) for m in members])
+        outs = ray_tpu.get([m.big_allreduce.remote(n) for m in members],
+                           timeout=120)
+        for head, peak, _r in outs:
+            if wire == "exact":
+                np.testing.assert_array_equal(head, want[:8])
+                assert peak == float(np.abs(want).max())
+            else:
+                # int8-blockscale: bounded error, not bit equality
+                assert np.abs(head - want[:8]).max() <= \
+                    float(np.abs(want).max()) / 254 * 4
+        remotes[(algo, wire)] = sum(r for _, _, r in outs)
+    hier, ring = remotes[("auto", "exact")], remotes[("ring", "exact")]
+    q8 = remotes[("auto", "int8-blockscale")]
+    assert 0 < hier < ring, (hier, ring)
+    assert q8 * 2 <= hier, (q8, hier)
+
+
 def test_cross_node_ring_collective(tcp_cluster):
     """Ring collective whose chunks actually cross the wire: one rank
     per OS-isolated node, payload above the tree threshold, so every
