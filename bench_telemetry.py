@@ -244,6 +244,57 @@ def collective_ab() -> tuple:
     return out["ring"], out["star"]
 
 
+def recorder_ab() -> tuple:
+    """Flight-recorder overhead gate: the same 4-rank 8 MB ring
+    allreduce with the recorder at the shipped capacity vs capacity 0
+    (off), INTERLEAVED and compared at the per-arm MEDIAN — the
+    recorder is always-on, so its budget is the strictest in this file
+    (< 1.05x wall). The per-event cost is a lock-free ring append plus
+    a dict lookup, and an 8 MB allreduce moves ~24 chunks per rank, so
+    a real regression here means per-chunk work grew by orders of
+    magnitude, not percent. Returns (on_s, off_s) per-call medians."""
+    import statistics as _st
+
+    from ray_tpu.comm import collective as col
+    from ray_tpu._private.config import CONFIG as C
+
+    shipped = max(1, C.flight_recorder_capacity)
+
+    @ray_tpu.remote(num_cpus=0)
+    class Rank(col.CollectiveActorMixin):
+        def __init__(self):
+            self.x = np.ones(2_097_152, np.float32)    # 8 MB
+
+        def set_capacity(self, cap: int) -> bool:
+            from ray_tpu._private.config import CONFIG as CC
+            CC._values["flight_recorder_capacity"] = cap
+            return True
+
+        def bench(self, group: str, rounds: int) -> bool:
+            for _ in range(rounds):
+                col.allreduce(self.x, group_name=group)
+            return True
+
+    world, rounds = 4, 3
+    members = [Rank.remote() for _ in range(world)]
+    col.create_collective_group(members, world, list(range(world)),
+                                group_name="bench_recorder")
+    ray_tpu.get([m.bench.remote("bench_recorder", 1) for m in members],
+                timeout=120)                           # warm the path
+    times = {0: [], shipped: []}
+    for _ in range(5):
+        for cap in (0, shipped):
+            ray_tpu.get([m.set_capacity.remote(cap) for m in members])
+            t0 = time.perf_counter()
+            ray_tpu.get([m.bench.remote("bench_recorder", rounds)
+                         for m in members], timeout=300)
+            times[cap].append((time.perf_counter() - t0) / rounds)
+    ray_tpu.get([m.set_capacity.remote(shipped) for m in members])
+    for m in members:
+        ray_tpu.kill(m)
+    return _st.median(times[shipped]), _st.median(times[0])
+
+
 def hierarchical_ab() -> dict:
     """Hierarchical-vs-flat gate on a 2-node x 2-rank IN-PROCESS
     cluster (8 MB float32 allreduce), plus the quantized-vs-exact
@@ -439,6 +490,13 @@ def main() -> None:
         # itself.
         ring_s, star_s = collective_ab()
         collective_ratio = ring_s / max(star_s, 1e-9)
+        # flight-recorder gate: the always-on recorder must cost < 5%
+        # on the same 4-rank 8 MB allreduce (interleaved medians — the
+        # acceptance bound of ISSUE 10; per-chunk recorder work is a
+        # lock-free ring append, so a trip here is structural, not
+        # noise)
+        recorder_on_s, recorder_off_s = recorder_ab()
+        recorder_ratio = recorder_on_s / max(recorder_off_s, 1e-9)
         # async-dispatch gate: lease pipelining must keep paying for
         # itself vs depth 1 ON THE SAME BOX (per the bench-box policy —
         # no cross-box absolutes). Budget < 1.0 with headroom: the
@@ -449,7 +507,7 @@ def main() -> None:
         ok = (submit_ratio < 1.2 and put_ratio < 1.2 and ns < 20_000
               and profile_ratio < 1.4 and prof_samples > 0
               and transport_ratio < 1.75 and collective_ratio < 0.9
-              and dispatch_ratio < 1.05)
+              and dispatch_ratio < 1.05 and recorder_ratio < 1.05)
         payload = {
             "metric": "telemetry_overhead",
             "submit_on_s": round(sub_on, 4),
@@ -469,6 +527,9 @@ def main() -> None:
             "collective_ring_s": round(ring_s, 4),
             "collective_star_s": round(star_s, 4),
             "collective_ratio": round(collective_ratio, 3),
+            "recorder_on_s": round(recorder_on_s, 4),
+            "recorder_off_s": round(recorder_off_s, 4),
+            "recorder_ratio": round(recorder_ratio, 3),
             "dispatch_pipelined_s": round(dispatch_piped_s, 4),
             "dispatch_depth1_s": round(dispatch_d1_s, 4),
             "dispatch_ratio": round(dispatch_ratio, 3),

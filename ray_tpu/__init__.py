@@ -223,6 +223,11 @@ def shutdown() -> None:
     # control plane, so local shard totals must not leak deltas into it
     from ._private import telemetry as _telemetry
     _telemetry.reset()
+    # so are the collective flight-recorder's ring and watermark tables
+    # (a stale ring would bleed this session's collective spans into the
+    # next session's state.timeline())
+    from ._private import flight_recorder as _flight_recorder
+    _flight_recorder.reset()
     # _system_config is session-scoped: the next init() must not inherit
     # this session's overrides (they'd silently change its behavior)
     CONFIG.reload()

@@ -266,6 +266,19 @@ class CoreClient:
                 self.conn.send((P.STACK_REPLY, (payload, dump)))
             except Exception:   # noqa: BLE001 — debugging is best-effort
                 pass
+        elif op == P.COLL_PROGRESS:
+            # flight-recorder watermark query, answered on THIS (reader)
+            # thread like STACK_DUMP: the rank thread may be wedged
+            # inside the very collective being diagnosed
+            from . import flight_recorder
+            try:
+                snap = flight_recorder.progress_snapshot(
+                    kind=("worker" if self.kind == P.KIND_WORKER
+                          else "driver"),
+                    worker_id=self.worker_id.hex())
+                self.conn.send((P.COLL_PROGRESS_REPLY, (payload, snap)))
+            except Exception:   # noqa: BLE001 — debugging is best-effort
+                pass
         elif op == P.PROFILE_START:
             # guarded like STACK_DUMP: an exception here (malformed
             # payload, can't-start-thread) would kill this process's
@@ -859,6 +872,25 @@ class CoreClient:
         return self._request(
             P.CLUSTER_PROFILE,
             lambda rid: (rid, dict(opts))).result(timeout=duration + 60.0)
+
+    def collective_health(self, timeout_s: float = 2.0) -> Any:
+        """Cluster-wide collective hang diagnosis: every rank's flight-
+        recorder watermarks, diffed into verdicts (dead rank / lost
+        chunk / lagging rank). Workers call this too — a rank that just
+        timed out diagnoses the hang before surfacing it."""
+        return self._request(
+            P.CLUSTER_COLL,
+            lambda rid: (rid, "health", timeout_s)).result(
+                timeout=timeout_s + 30.0)
+
+    def flight_records(self, timeout_s: float = 2.0) -> Any:
+        """Every process's recent flight-recorder events + completed-op
+        records (the raw material behind ``state.flight_records()`` and
+        the timeline's collective spans)."""
+        return self._request(
+            P.CLUSTER_COLL,
+            lambda rid: (rid, "records", timeout_s)).result(
+                timeout=timeout_s + 30.0)
 
     def create_placement_group(self, spec: P.PlacementGroupSpec):
         return self._request(P.CREATE_PG, lambda rid: (rid, spec)).result()

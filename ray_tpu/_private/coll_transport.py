@@ -22,6 +22,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from . import context
+from . import flight_recorder
 from . import locksan
 from . import telemetry
 from .config import CONFIG
@@ -37,6 +38,11 @@ M_COLL_INFLIGHT = telemetry.define(
     "gauge", "rtpu_collective_inflight_chunks",
     "Collective chunks delivered to this process but not yet consumed "
     "by a waiting rank thread")
+M_COLL_HOP = telemetry.define(
+    "histogram", "rtpu_collective_hop_seconds",
+    "Time a rank thread spent blocked waiting for one collective chunk "
+    "to arrive, tagged by schedule phase — per-rank hop-latency skew "
+    "makes chronic stragglers visible before they become hangs")
 
 _lock = locksan.lock("coll.mailbox")
 _cond = locksan.condition("coll.mailbox", _lock)
@@ -87,6 +93,7 @@ def send(dest: Tuple[bytes, bytes], key: tuple, payload,
     from . import protocol as P
     client = context.require_client()
     nbytes = payload_nbytes(payload)
+    flight_recorder.note_send(key, nbytes)
     client.conn.send((P.COLL_ROUTE, (dest[0], dest[1], key, payload)))
     _stats["sent_chunks"] += 1
     _stats["sent_bytes"] += nbytes
@@ -102,6 +109,9 @@ def send(dest: Tuple[bytes, bytes], key: tuple, payload,
 def deposit(key: tuple, value) -> None:
     """Reader-thread side: park an arrived chunk and wake waiters."""
     now = time.monotonic()
+    # ring-only recorder hook BEFORE taking the mailbox lock (lock-free
+    # append; the reader thread must never nest another lock here)
+    flight_recorder.note_deliver(key, payload_nbytes(value))
     with _cond:
         _slots[key] = value
         _born[key] = now
@@ -121,6 +131,8 @@ def deposit(key: tuple, value) -> None:
 def wait(key: tuple, deadline: float, what: str = "collective chunk"):
     """Block until ``key``'s chunk arrives; raises TimeoutError at the
     deadline (a dead peer must not hang the survivors)."""
+    t0 = time.monotonic()
+    flight_recorder.note_wait(key)
     with _cond:
         while key not in _slots:
             remaining = deadline - time.monotonic()
@@ -133,6 +145,11 @@ def wait(key: tuple, deadline: float, what: str = "collective chunk"):
         value = _slots.pop(key)
         _born.pop(key, None)
         n = len(_slots)
+    nbytes = payload_nbytes(value)
+    flight_recorder.note_recv(key, nbytes)
+    _okey, phase = flight_recorder.parse_key(key)
+    telemetry.hist_observe(M_COLL_HOP, time.monotonic() - t0,
+                           (("phase", phase),))
     telemetry.gauge_set(M_COLL_INFLIGHT, float(n))
     return value
 
@@ -148,6 +165,21 @@ def flush() -> None:
     client = context.current_client
     if client is not None:
         client.conn.flush()
+
+
+def drop_call(group: str, epoch: str, seq) -> None:
+    """Discard undelivered chunks of ONE timed-out call (keys lead with
+    (group, epoch, seq)): nothing will ever consume them, and without
+    this the ``rtpu_collective_inflight_chunks`` gauge stays elevated
+    for up to ``collective_call_ttl_s`` after every failed collective —
+    the gauge must return to 0 when the failure is handled, not when
+    the sweep happens by."""
+    prefix = (group, epoch, seq)
+    with _cond:
+        for k in [k for k in _slots if k[:3] == prefix]:
+            del _slots[k]
+            _born.pop(k, None)
+        telemetry.gauge_set(M_COLL_INFLIGHT, float(len(_slots)))
 
 
 def drop_group(group: str, epoch: str) -> None:

@@ -250,6 +250,17 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                      "int8-blockscale wire format; one "
                                      "float32 scale rides along per "
                                      "block"),
+    "flight_recorder_capacity": (int, 4096,
+                                 "event slots in the per-process "
+                                 "collective flight-recorder ring "
+                                 "(always-on, lock-free appends); 0 "
+                                 "disables recording AND the timeout "
+                                 "hang diagnosis"),
+    "coll_progress_timeout_s": (float, 2.0,
+                                "deadline for one COLL_PROGRESS "
+                                "watermark fan-out (hang diagnosis; "
+                                "answered on reader threads, so even "
+                                "wedged ranks reply within this)"),
     "object_transfer_chunk_bytes": (int, 8 << 20,
                                     "cross-host object pulls stream in "
                                     "chunks of this size (reference: "

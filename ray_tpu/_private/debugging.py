@@ -70,9 +70,24 @@ def thread_stacks() -> List[dict]:
 
 def collect_stack_dump(kind: str = "process", **ids) -> dict:
     """One process's stack dump record (the ``STACK_DUMP`` reply body).
-    ``ids`` carries identity tags (worker_id, node_id, ...)."""
-    return {"kind": kind, "pid": os.getpid(), "timestamp": time.time(),
-            "threads": thread_stacks(), **ids}
+    ``ids`` carries identity tags (worker_id, node_id, ...). The dump
+    also names the task currently executing in this process (best-
+    effort read of the execution context from the reader thread) so a
+    control-plane diagnosis — e.g. the stall detector's
+    ``collective_stuck`` probe — can map a stalled task to its worker's
+    stack without a worker registry round trip."""
+    from . import context
+    out = {"kind": kind, "pid": os.getpid(), "timestamp": time.time(),
+           "threads": thread_stacks(), **ids}
+    tid = getattr(context, "current_task_id", None)
+    if tid is not None:
+        try:
+            out.setdefault("task_id", tid.hex())
+            out.setdefault("task_name",
+                           getattr(context, "current_task_name", None))
+        except Exception:   # noqa: BLE001 — identity tags are optional
+            pass
+    return out
 
 
 def format_stack_dump(dump: dict) -> str:

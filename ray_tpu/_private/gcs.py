@@ -881,18 +881,30 @@ class GlobalControlPlane:
     _STALL_PENDING_STATES = ("PENDING_ARGS_AVAIL",
                              "PENDING_NODE_ASSIGNMENT")
 
-    def maybe_sweep_stalls(self) -> List[dict]:
+    def maybe_sweep_stalls(self, coll_probe=None) -> List[dict]:
         """Rate-limited sweep: flag tasks sitting in a pending state (or
         RUNNING) past the configured thresholds, each with a diagnosed
         *cause* — unsatisfiable resource shape, a never-ready dependency,
-        a dead target actor, or plain queue saturation. Returns the
-        newly-diagnosed records; the caller emits them as WARNING
-        cluster events."""
+        a dead target actor, a collective wait that outlived half its
+        timeout (``collective_stuck``, see below), or plain queue
+        saturation. Returns the newly-diagnosed records; the caller
+        emits them as WARNING cluster events.
+
+        ``coll_probe`` (provided by the hosting node) takes a list of
+        ``(TaskEvent, age_s)`` RUNNING candidates older than
+        ``collective_timeout_s / 2`` and returns ``(ev, cause, message)``
+        triples for the ones whose worker stack shows them parked in a
+        collective wait. It fans out RPCs, so it runs strictly OUTSIDE
+        the plane lock — candidates are gathered locked, probed
+        unlocked, and de-duplicated through ``_stall_warned`` like every
+        other cause."""
         interval = CONFIG.stall_detector_interval_s
         if interval <= 0:
             return []
         now = time.time()
         out: List[dict] = []
+        coll_half = CONFIG.collective_timeout_s / 2.0
+        coll_candidates: List[tuple] = []
         with self._lock:
             if now - self._stall_last_sweep < interval:
                 return []
@@ -919,6 +931,14 @@ class GlobalControlPlane:
                     threshold = CONFIG.stall_pending_threshold_s
                 elif ev.state == "RUNNING":
                     threshold = CONFIG.stall_running_threshold_s
+                    age = now - ev.timestamp
+                    if (coll_probe is not None and coll_half > 0
+                            and age >= coll_half
+                            and self._stall_warned.get(tid)
+                            != "collective_stuck"):
+                        # a collective wedges long before the generic
+                        # RUNNING threshold (300s default vs timeout/2)
+                        coll_candidates.append((ev, age))
                 else:
                     self._stall_warned.pop(tid, None)
                     continue
@@ -927,6 +947,11 @@ class GlobalControlPlane:
                     continue
                 cause, message = self._diagnose_stall_locked(
                     ev, total, avail, n_pending, age, latest)
+                if (cause == "slow_running" and self._stall_warned.get(
+                        tid) == "collective_stuck"):
+                    # collective_stuck is the more specific refinement
+                    # of slow_running — don't flip-flop between them
+                    continue
                 if self._stall_warned.get(tid) == cause:
                     continue
                 self._stall_warned[tid] = cause
@@ -935,6 +960,22 @@ class GlobalControlPlane:
                             "task_name": ev.name,
                             "task_state": ev.state,
                             "age_s": round(age, 1),
+                            "cause": cause})
+        if coll_candidates and coll_probe is not None:
+            try:
+                probed = coll_probe(coll_candidates) or []
+            except Exception:   # noqa: BLE001 — diagnosis is best-effort
+                probed = []
+            for ev, cause, message in probed:
+                with self._lock:
+                    if self._stall_warned.get(ev.task_id) == cause:
+                        continue
+                    self._stall_warned[ev.task_id] = cause
+                out.append({"message": message,
+                            "task_id": ev.task_id.hex(),
+                            "task_name": ev.name,
+                            "task_state": ev.state,
+                            "age_s": round(now - ev.timestamp, 1),
                             "cause": cause})
         return out
 

@@ -98,6 +98,9 @@ REGISTRY: Dict[str, tuple] = {
     # --- collective data plane
     "coll.mailbox": ("_private/coll_transport.py", "condition", 42,
                      "per-process chunk mailbox; condvar wakes waiters"),
+    "coll.recorder": ("_private/flight_recorder.py", "lock", 43,
+                      "flight-recorder group/op tables (ring appends "
+                      "are lock-free; this guards begin/end/snapshot)"),
     # --- independent leaves (never co-held today; distinct levels so a
     # --- future nesting trips the sanitizer instead of passing silently)
     "events.file": ("_private/events.py", "lock", 44,

@@ -578,6 +578,12 @@ class NodeService:
         self._debug_lock = locksan.lock("node.debug")
         self._debug_futures: Dict[int, Future] = {}
         self._next_debug_token = 1
+        # short-TTL cache of the last collective-health report: one dead
+        # rank makes every survivor diagnose near-simultaneously, and W
+        # identical cluster-wide fan-outs at the exact moment the
+        # cluster is wedged would be a thundering herd
+        self._coll_health_cache: Tuple[float, Optional[dict]] = (0.0,
+                                                                 None)
 
         self._rng = random.Random(self.node_id.binary())
 
@@ -902,12 +908,73 @@ class NodeService:
         if not isinstance(self.gcs, GlobalControlPlane):
             return
         try:
-            stalls = self.gcs.maybe_sweep_stalls()
+            stalls = self.gcs.maybe_sweep_stalls(
+                coll_probe=self._coll_stall_probe)
         except Exception:   # noqa: BLE001 — diagnosis must not kill ticks
             return
         for rec in stalls:
             self.events.warning("TASK_STALL",
                                 rec.pop("message", "task stalled"), **rec)
+
+    def _coll_stall_probe(self, candidates: List[tuple]) -> List[tuple]:
+        """``collective_stuck`` half of the stall sweep (runs on the
+        tick thread, OUTSIDE the plane lock). Cheap pre-filter first:
+        one COLL_PROGRESS fan-out — no stuck collective anywhere means
+        no stack collection at all. Only when the diagnoser has a
+        verdict do we collect cluster stacks and pair each candidate
+        task (by the task_id its worker's dump now carries) with a
+        thread parked in ``coll_transport.wait``."""
+        verdicts = []
+        try:
+            report = self.collective_health(
+                min(2.0, CONFIG.coll_progress_timeout_s), quiet=True)
+            verdicts = report.get("verdicts") or []
+        except Exception:   # noqa: BLE001 — diagnosis is best-effort
+            return []
+        if not verdicts:
+            return []
+        try:
+            stacks = self._collect_nodes_debug(("stacks", 1.0), 1.0)
+        except Exception:   # noqa: BLE001
+            return []
+        by_task = {}
+        for dumps in stacks.values():
+            for d in dumps or []:
+                if d.get("task_id"):
+                    by_task[d["task_id"]] = d
+        # worker -> collective groups it belongs to, so a candidate gets
+        # the verdict for ITS stuck group (two concurrently-stuck groups
+        # must not cross-attribute their diagnoses)
+        groups_of = {}
+        for m in report.get("members", ()):
+            if m.get("worker_id"):
+                groups_of.setdefault(m["worker_id"], set()).add(
+                    m["group"])
+        out = []
+        for ev, age in candidates:
+            dump = by_task.get(ev.task_id.hex())
+            if dump is None:
+                continue
+            in_coll = any(
+                "coll_transport" in fr and "wait" in fr
+                for th in dump.get("threads", ())
+                for fr in th.get("frames", ()))
+            if not in_coll:
+                continue
+            my_groups = groups_of.get(dump.get("worker_id"), set())
+            matched = [v for v in verdicts if v.get("group") in my_groups]
+            if not matched:
+                # no verdict for THIS task's groups: it is not stuck in
+                # a diagnosed collective — never cross-attribute another
+                # group's diagnosis
+                continue
+            verdict_msg = matched[0].get(
+                "message", "see state.collective_health()")
+            out.append((ev, "collective_stuck",
+                        f"task {ev.name!r} has been parked in a "
+                        f"collective wait for {age:.0f}s (past "
+                        f"collective_timeout_s/2) — {verdict_msg}"))
+        return out
 
     def _check_memory_pressure(self) -> None:
         """Kill one worker per check while above the usage threshold
@@ -1036,6 +1103,7 @@ class NodeService:
                              # block) the dispatcher
                              P.STACK_REPLY, P.PROFILE_REPORT,
                              P.CLUSTER_STACKS, P.CLUSTER_PROFILE,
+                             P.COLL_PROGRESS_REPLY, P.CLUSTER_COLL,
                              # collective chunks are data plane: routed
                              # on the arrival reader thread so a ring
                              # step never queues behind task dispatch
@@ -1065,7 +1133,7 @@ class NodeService:
                         if op in (P.OBJ_GET_META, P.OBJ_PULL_CHUNK,
                                   P.PG_RESERVE, P.NODE_STATS,
                                   P.ALLOC_OBJECT, P.CLUSTER_STACKS,
-                                  P.CLUSTER_PROFILE
+                                  P.CLUSTER_PROFILE, P.CLUSTER_COLL
                                   ) and isinstance(payload, tuple):
                             result = False if op == P.PG_RESERVE else None
                             self._reply(key, P.INFO_REPLY,
@@ -1122,12 +1190,20 @@ class NodeService:
             else:
                 self._reply(key, P.INFO_REPLY,
                             (req_id, self.node_stats(what)))  # lint: allow-on-reader(non-tuple whats are pure snapshots; the blocking tuple forms take the _spawn_debug_reply thread above)
-        elif op in (P.STACK_REPLY, P.PROFILE_REPORT):
+        elif op in (P.STACK_REPLY, P.PROFILE_REPORT,
+                    P.COLL_PROGRESS_REPLY):
             token, data = payload
             with self._debug_lock:
                 fut = self._debug_futures.pop(token, None)
             if fut is not None and not fut.done():
                 fut.set_result(data)
+        elif op == P.CLUSTER_COLL:
+            req_id, what, timeout_s = payload
+            self._spawn_debug_reply(
+                key, req_id,
+                lambda w=what, t=timeout_s: (
+                    self.collective_health(float(t)) if w == "health"
+                    else self.collect_flight_records(float(t))))
         elif op == P.CLUSTER_STACKS:
             req_id, timeout_s = payload
             self._spawn_debug_reply(
@@ -1211,6 +1287,8 @@ class NodeService:
                 return self.collect_local_stacks(float(what[1]))
             if what[0] == "profile":
                 return self.collect_local_profile(dict(what[1] or {}))
+            if what[0] == "coll":
+                return self.collect_local_coll_progress(float(what[1]))
             return None
         if what == "available":
             return self.available_snapshot()
@@ -1324,6 +1402,102 @@ class NodeService:
         waits = self._debug_fanout(targets, P.PROFILE_START,
                                    lambda t: (t, opts))
         return self._debug_collect(waits, duration + 10.0)
+
+    def collect_local_coll_progress(self, timeout_s: float = 2.0
+                                    ) -> List[dict]:
+        """Flight-recorder progress snapshots of every locally-connected
+        worker AND driver (a driver can be a collective rank). Replies
+        arrive on each process's reader thread — a rank wedged inside
+        the collective being diagnosed still answers."""
+        node_hex = self.node_id.hex()[:12]
+        targets = []
+        for w in list(self._workers.values()):
+            if w.conn is not None:
+                targets.append((w.conn, {"node_id": node_hex}))
+        for key in list(self._driver_conn_keys):
+            conn = self._conns.get(key)
+            if conn is not None:
+                targets.append((conn, {"node_id": node_hex}))
+        waits = self._debug_fanout(targets, P.COLL_PROGRESS, lambda t: t)
+        return self._debug_collect(waits, timeout_s)
+
+    def _collect_cluster_coll(self, timeout_s: float) -> Dict[str, Any]:
+        return {hexid: snaps or []
+                for hexid, snaps in self._collect_nodes_debug(
+                    ("coll", timeout_s), timeout_s).items()}
+
+    def collective_health(self, timeout_s: Optional[float] = None,
+                          quiet: bool = False) -> dict:
+        """Cluster-wide collective hang & straggler diagnosis: collect
+        every rank's flight-recorder watermarks, diff them, and name
+        the verdict per stuck op — dead rank, lost chunk, or lagging
+        rank (with the lagging rank's current thread stack attached
+        from a PR-2 stack dump when one can be matched)."""
+        from . import flight_recorder
+        cached_at, cached = self._coll_health_cache
+        if cached is not None and time.monotonic() - cached_at < 1.0:
+            return cached
+        t = timeout_s if timeout_s is not None \
+            else CONFIG.coll_progress_timeout_s
+        per_node = self._collect_cluster_coll(t)
+        report = flight_recorder.diagnose(per_node)
+        lagging = [v for v in report.get("verdicts", ())
+                   if v.get("verdict") == "lagging_rank"]
+        if lagging:
+            self._attach_lagging_stacks(report, lagging, per_node)
+        if not quiet:
+            self.events.info(
+                "DEBUG_COLLECTIVES",
+                "collected cluster-wide collective health",
+                ops=len(report.get("ops", ())),
+                verdicts=len(report.get("verdicts", ())))
+        self._coll_health_cache = (time.monotonic(), report)
+        return report
+
+    def _attach_lagging_stacks(self, report: dict, lagging: List[dict],
+                               per_node: Dict[str, Any]) -> None:
+        """Best-effort: name WHERE each lagging rank is stuck by pairing
+        its endpoint with a cluster stack dump."""
+        # rank -> worker hex prefix, from any snapshot's group registry
+        eps: Dict[tuple, list] = {}
+        for snaps in per_node.values():
+            for s in snaps or []:
+                for g in s.get("groups", ()):
+                    if g.get("endpoints"):
+                        eps[(g["group"], g["epoch"])] = g["endpoints"]
+        try:
+            stacks = self._collect_nodes_debug(("stacks", 1.0), 1.0)
+        except Exception:   # noqa: BLE001 — stacks are garnish
+            return
+        dumps = [d for ds in stacks.values() for d in ds or []]
+        for v in lagging:
+            group_eps = eps.get((v["group"], v["epoch"])) or []
+            ep = (group_eps[v["rank"]]
+                  if 0 <= v["rank"] < len(group_eps) else None)
+            if not ep:
+                continue
+            for d in dumps:
+                wid = d.get("worker_id") or ""
+                if not wid.startswith(ep[1]):
+                    continue
+                th = next(
+                    (t for t in d.get("threads", ())
+                     if any("coll_transport" in fr
+                            for fr in t.get("frames", ()))),
+                    None) or next(
+                    (t for t in d.get("threads", ())
+                     if t.get("thread_name") == "task-exec"), None)
+                if th is not None:
+                    v["stack"] = list(th.get("frames", ()))
+                break
+
+    def collect_flight_records(self, timeout_s: Optional[float] = None
+                               ) -> dict:
+        """Every process's raw flight-recorder snapshot (recent event
+        ring + completed-op records), keyed by node."""
+        t = timeout_s if timeout_s is not None \
+            else CONFIG.coll_progress_timeout_s
+        return {"nodes": self._collect_cluster_coll(t)}
 
     def _collect_nodes_debug(self, what: tuple,
                              timeout_s: float) -> Dict[str, Any]:

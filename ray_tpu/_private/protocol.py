@@ -152,6 +152,19 @@ COLL_FWD = 75           # node -> node: same body, deliver on the dst node
 COLL_DELIVER = 76       # node -> client push: (key, payload) — deposited
                         # into the process mailbox (coll_transport.py)
 
+# Collective flight-recorder progress plane (reference analogue: the
+# NCCL flight recorder's dump collection). COLL_PROGRESS is pushed to
+# every worker/driver conn and answered on the RECEIVER's reader thread
+# — like STACK_DUMP, so a rank wedged inside a collective wait still
+# reports its watermarks. CLUSTER_COLL is the driver/worker-facing
+# collection op: the node fans out locally and across the node plane
+# (NODE_STATS ("coll", timeout)) and replies with the aggregated
+# snapshots or the diagnosed health report.
+COLL_PROGRESS = 77      # node -> worker/driver push: token
+COLL_PROGRESS_REPLY = 78  # worker/driver -> node: (token, snapshot dict)
+CLUSTER_COLL = 79       # any client -> node: (req_id, what, timeout_s)
+                        # what = "health" | "records" -> INFO_REPLY dict
+
 # Generic coalesced frame: (BATCH, [(op, payload), ...]). Produced by
 # the Connection writer when several messages are pending at flush time
 # — ONE pickle stream + one frame + one receiver wakeup for the burst —

@@ -59,6 +59,7 @@ import numpy as np
 from .. import get, get_actor
 from ..api import remote
 from .._private import coll_transport
+from .._private import flight_recorder
 from .._private import locksan
 from .._private import telemetry
 from .._private.config import CONFIG
@@ -84,6 +85,10 @@ M_COLL_QUANT_SAVED = telemetry.define(
     "counter", "rtpu_collective_quantized_bytes_total",
     "Wire bytes SAVED by the block-quantized inter-node format "
     "(original minus encoded payload bytes, summed over quantized hops)")
+M_COLL_TIMEOUTS = telemetry.define(
+    "counter", "rtpu_collective_timeouts_total",
+    "Collective calls that failed with a TimeoutError on this rank "
+    "(each one triggers the flight-recorder hang diagnosis)")
 
 
 def _observe(op: str, group: str, nbytes: int, t0: float) -> None:
@@ -580,6 +585,8 @@ def init_collective_group(world_size: int, rank: int,
           if CONFIG.collective_p2p_enabled else None)
     epoch, endpoints = _coord(coordinator, "join", rank, ep,
                               CONFIG.collective_timeout_s)
+    flight_recorder.register_group(group_name, epoch, rank, world_size,
+                                   endpoints)
     with _groups_lock:
         _process_groups[group_name] = _GroupState(
             group_name, world_size, rank, coordinator, epoch, endpoints)
@@ -627,6 +634,7 @@ def destroy_collective_group(group_name: str = "default") -> None:
         state = _process_groups.pop(group_name, None)
     if state is None:
         return
+    flight_recorder.unregister_group(state.name, state.epoch)
     coll_transport.drop_group(state.name, state.epoch)
     if state.rank == 0:
         from .. import kill
@@ -1058,6 +1066,74 @@ def _pick(state: _GroupState, op: str, nbytes: int, dtype) -> str:
     return algo
 
 
+def _remote_verdict(state: _GroupState, okey) -> str:
+    """Best-effort cluster-wide hang diagnosis after a local timeout:
+    fan the COLL_PROGRESS query out through the control plane (answered
+    on every process's reader thread — a peer wedged inside the same
+    collective still replies), diff watermarks, and return the verdict
+    sentence(s) for this group/op. Empty string when no runtime client
+    is attached or the diagnosis itself fails."""
+    from .._private import context
+    client = context.current_client
+    if client is None or not flight_recorder.enabled():
+        return ""
+    try:
+        report = client.collective_health(
+            CONFIG.coll_progress_timeout_s) or {}
+    except Exception:   # noqa: BLE001 — diagnosis must not mask the error
+        return ""
+    want = okey if isinstance(okey, int) else list(okey)
+    verdicts = [v for v in report.get("verdicts", ())
+                if v.get("group") == state.name and v.get("seq") == want]
+    if not verdicts:
+        verdicts = [v for v in report.get("verdicts", ())
+                    if v.get("group") == state.name]
+    return "; ".join(v.get("message", "") for v in verdicts[:2])
+
+
+def _run_op(state: _GroupState, op: str, algo: str, okey, nbytes: int,
+            fn):
+    """Run one public op's data path under the flight recorder.
+
+    On success the op record retires into the recorder's completed ring
+    (``state.timeline()`` renders those as spans). On a TimeoutError the
+    failure is handled, not just raised: the timeout counter bumps, the
+    cluster-wide diagnosis runs WHILE this rank's watermark record is
+    still live (both survivors of a dead rank time out near-
+    simultaneously — dropping the record first would blind the peer's
+    diagnosis), the verdict is appended to the exception message, and
+    the failed call's undelivered chunks are dropped from the mailbox so
+    ``rtpu_collective_inflight_chunks`` returns to 0 now instead of at
+    the TTL sweep."""
+    flight_recorder.op_begin(state.name, state.epoch, okey, op, algo,
+                             nbytes, state.world_size, state.rank)
+    try:
+        out = fn()
+    except TimeoutError as exc:
+        telemetry.counter_inc(M_COLL_TIMEOUTS, 1.0,
+                              (("group", state.name), ("op", op)))
+        flight_recorder.op_error(state.name, okey, str(exc))
+        detail = _remote_verdict(state, okey)
+        flight_recorder.op_end(state.name, okey)
+        if isinstance(okey, int):
+            # p2p send/recv awaited exactly one key that never arrived
+            # — only sequenced schedule calls can strand delivered chunks
+            coll_transport.drop_call(state.name, state.epoch, okey)
+        msg = str(exc)
+        if detail:
+            msg = f"{msg} [diagnosis: {detail}]"
+        raise TimeoutError(msg) from None
+    except BaseException as exc:
+        # any other failure (dead coordinator actor, mismatched-shape
+        # reduce, ...) must still retire the watermark record, or the
+        # op reads as STUCK in every later health report
+        flight_recorder.op_end(state.name, okey,
+                               error=f"{type(exc).__name__}: {exc}")
+        raise
+    flight_recorder.op_end(state.name, okey)
+    return out
+
+
 def allreduce(tensor, group_name: str = "default", op: str = SUM,
               timeout: Optional[float] = None):
     """All-reduce; returns the reduced array (reference mutates in place —
@@ -1070,32 +1146,35 @@ def allreduce(tensor, group_name: str = "default", op: str = SUM,
     t0 = time.monotonic()
     seq = state.next_seq()
     algo = _pick(state, "allreduce", arr.nbytes, arr.dtype)
-    if algo == "local":
-        result = np.array(arr)
-    elif algo == "star":
-        result = np.asarray(_coord(state.coordinator, "rendezvous",
-                                   state.key(seq), state.rank, arr, op,
-                                   _timeout_s(timeout)))
-    elif algo == "tree":
-        key, deadline = state.key(seq), _deadline(timeout)
-        total = _tree_reduce(state, arr, op, key, deadline, "allreduce")
-        result = _tree_bcast_small(state, total, 0, key, deadline,
-                                   "allreduce").reshape(arr.shape)
-        # the fanned-out buffer aliases the returned array (root) — the
-        # caller may mutate it the moment we return, so the zero-copy
-        # sends must have left this process first
-        coll_transport.flush()
-    elif algo == "hierarchical":
-        key, deadline = state.key(seq), _deadline(timeout)
-        codec = _make_codec()
-        buf = arr.reshape(-1).copy()
-        out = _hier_allreduce(state, buf, op, key, deadline,
-                              "allreduce", codec)
-        # leaders fan out zero-copy views of the result they return
-        coll_transport.flush()
-        _observe_quant(codec, "allreduce", group_name)
-        result = out.reshape(arr.shape)
-    else:
+
+    def run():
+        if algo == "local":
+            return np.array(arr)
+        if algo == "star":
+            return np.asarray(_coord(state.coordinator, "rendezvous",
+                                     state.key(seq), state.rank, arr, op,
+                                     _timeout_s(timeout)))
+        if algo == "tree":
+            key, deadline = state.key(seq), _deadline(timeout)
+            total = _tree_reduce(state, arr, op, key, deadline,
+                                 "allreduce")
+            result = _tree_bcast_small(state, total, 0, key, deadline,
+                                       "allreduce").reshape(arr.shape)
+            # the fanned-out buffer aliases the returned array (root) —
+            # the caller may mutate it the moment we return, so the
+            # zero-copy sends must have left this process first
+            coll_transport.flush()
+            return result
+        if algo == "hierarchical":
+            key, deadline = state.key(seq), _deadline(timeout)
+            codec = _make_codec()
+            buf = arr.reshape(-1).copy()
+            out = _hier_allreduce(state, buf, op, key, deadline,
+                                  "allreduce", codec)
+            # leaders fan out zero-copy views of the result they return
+            coll_transport.flush()
+            _observe_quant(codec, "allreduce", group_name)
+            return out.reshape(arr.shape)
         key, deadline = state.key(seq), _deadline(timeout)
         buf = arr.reshape(-1).copy()
         n = buf.size
@@ -1108,7 +1187,9 @@ def allreduce(tensor, group_name: str = "default", op: str = SUM,
         # allgather-phase sends are views of ``buf``, which the caller
         # receives (and may mutate) as the result — flush before return
         coll_transport.flush()
-        result = buf.reshape(arr.shape)
+        return buf.reshape(arr.shape)
+
+    result = _run_op(state, "allreduce", algo, seq, arr.nbytes, run)
     _observe("allreduce", group_name, arr.nbytes, t0)
     return result
 
@@ -1123,19 +1204,23 @@ def allgather(tensor, group_name: str = "default",
     seq = state.next_seq()
     w, r = state.world_size, state.rank
     algo = _pick(state, "allgather", arr.nbytes, arr.dtype)
-    if algo == "local":
-        parts: List[np.ndarray] = [np.array(arr)]
-    elif algo == "star":
-        parts = [np.asarray(p) for p in _coord(
-            state.coordinator, "rendezvous", state.key(seq), r, arr,
-            None, _timeout_s(timeout))]
-    elif algo == "hierarchical":
-        key, deadline = state.key(seq), _deadline(timeout)
-        parts = _hier_allgather(state, arr, key, deadline, "allgather")
-        # the caller's own ``arr`` (and, on leaders, the returned parts)
-        # went out zero-copy — flush the link before they can be mutated
-        coll_transport.flush()
-    else:
+
+    def run():
+        if algo == "local":
+            return [np.array(arr)]
+        if algo == "star":
+            return [np.asarray(p) for p in _coord(
+                state.coordinator, "rendezvous", state.key(seq), r, arr,
+                None, _timeout_s(timeout))]
+        if algo == "hierarchical":
+            key, deadline = state.key(seq), _deadline(timeout)
+            parts = _hier_allgather(state, arr, key, deadline,
+                                    "allgather")
+            # the caller's own ``arr`` (and, on leaders, the returned
+            # parts) went out zero-copy — flush the link before they
+            # can be mutated
+            coll_transport.flush()
+            return parts
         key, deadline = state.key(seq), _deadline(timeout)
         out: List[Any] = [None] * w
         out[r] = arr
@@ -1150,7 +1235,9 @@ def allgather(tensor, group_name: str = "default",
         # the caller's own ``arr`` went onto the ring zero-copy and the
         # caller may mutate it once we return — flush the link first
         coll_transport.flush()
-        parts = [np.asarray(p) for p in out]
+        return [np.asarray(p) for p in out]
+
+    parts = _run_op(state, "allgather", algo, seq, arr.nbytes, run)
     _observe("allgather", group_name, arr.nbytes, t0)
     return parts
 
@@ -1171,34 +1258,37 @@ def reducescatter(tensor, group_name: str = "default", op: str = SUM,
             f"by world size {w}")
     rows = arr.shape[0] // w
     algo = _pick(state, "reducescatter", arr.nbytes, arr.dtype)
-    if algo == "local":
-        result = np.array(arr)
-    elif algo == "star":
-        reduced = np.asarray(_coord(state.coordinator, "rendezvous",
-                                    state.key(seq), r, arr, op,
-                                    _timeout_s(timeout)))
-        result = reduced[r * rows:(r + 1) * rows]
-    elif algo == "hierarchical":
-        key, deadline = state.key(seq), _deadline(timeout)
-        codec = _make_codec()
-        buf = arr.reshape(-1).copy()
-        seg_elems = rows * (buf.size // arr.shape[0])
-        out = _hier_reducescatter(state, buf, op, seg_elems, key,
-                                  deadline, "reducescatter", codec)
-        # leaders ship zero-copy slices of the buffer they keep a slice
-        # of — flush before the caller can mutate the result
-        coll_transport.flush()
-        _observe_quant(codec, "reducescatter", group_name)
-        result = out.reshape((rows,) + arr.shape[1:]).copy()
-    else:
+
+    def run():
+        if algo == "local":
+            return np.array(arr)
+        if algo == "star":
+            reduced = np.asarray(_coord(state.coordinator, "rendezvous",
+                                        state.key(seq), r, arr, op,
+                                        _timeout_s(timeout)))
+            return reduced[r * rows:(r + 1) * rows]
+        if algo == "hierarchical":
+            key, deadline = state.key(seq), _deadline(timeout)
+            codec = _make_codec()
+            buf = arr.reshape(-1).copy()
+            seg_elems = rows * (buf.size // arr.shape[0])
+            out = _hier_reducescatter(state, buf, op, seg_elems, key,
+                                      deadline, "reducescatter", codec)
+            # leaders ship zero-copy slices of the buffer they keep a
+            # slice of — flush before the caller can mutate the result
+            coll_transport.flush()
+            _observe_quant(codec, "reducescatter", group_name)
+            return out.reshape((rows,) + arr.shape[1:]).copy()
         key, deadline = state.key(seq), _deadline(timeout)
         buf = arr.reshape(-1).copy()
         seg_elems = rows * (buf.size // arr.shape[0])
         bounds = [i * seg_elems for i in range(w + 1)]
         _ring_reduce_scatter(state, buf, bounds, op, key, deadline,
                              "reducescatter")
-        result = buf[bounds[r]:bounds[r + 1]].reshape(
+        return buf[bounds[r]:bounds[r + 1]].reshape(
             (rows,) + arr.shape[1:]).copy()
+
+    result = _run_op(state, "reducescatter", algo, seq, arr.nbytes, run)
     _observe("reducescatter", group_name, arr.nbytes, t0)
     return result
 
@@ -1215,19 +1305,22 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
     is_src = state.rank == src_rank
     algo = _pick(state, "broadcast", arr.nbytes if is_src else 0,
                  arr.dtype)
-    if algo == "local":
-        result = np.array(arr)
-    elif algo == "star":
-        parts = _coord(state.coordinator, "rendezvous", state.key(seq),
-                       state.rank, arr if is_src else None, None,
-                       _timeout_s(timeout))
-        result = np.asarray(parts[src_rank])
-    elif algo == "hierarchical":
-        result = _hier_broadcast(state, arr if is_src else None,
-                                 src_rank, state.key(seq),
-                                 _deadline(timeout), "broadcast")
-        coll_transport.flush()
-    else:
+
+    def run():
+        if algo == "local":
+            return np.array(arr)
+        if algo == "star":
+            parts = _coord(state.coordinator, "rendezvous",
+                           state.key(seq), state.rank,
+                           arr if is_src else None, None,
+                           _timeout_s(timeout))
+            return np.asarray(parts[src_rank])
+        if algo == "hierarchical":
+            result = _hier_broadcast(state, arr if is_src else None,
+                                     src_rank, state.key(seq),
+                                     _deadline(timeout), "broadcast")
+            coll_transport.flush()
+            return result
         result = _tree_bcast_chunked(state, arr if is_src else None,
                                      src_rank, state.key(seq),
                                      _deadline(timeout), "broadcast")
@@ -1235,6 +1328,10 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
         # (contiguous input: ascontiguousarray is a no-copy) — it must
         # be on the wire before the caller can touch it again
         coll_transport.flush()
+        return result
+
+    result = _run_op(state, "broadcast", algo, seq,
+                     arr.nbytes if is_src else 0, run)
     _observe("broadcast", group_name, arr.nbytes if is_src else 0, t0)
     return result
 
@@ -1247,16 +1344,21 @@ def barrier(group_name: str = "default",
     t0 = time.monotonic()
     seq = state.next_seq()
     algo = _pick(state, "barrier", 0, np.dtype(np.uint8))
-    if algo == "local":
-        pass
-    elif algo == "star":
-        _coord(state.coordinator, "rendezvous", state.key(seq),
-               state.rank, None, None, _timeout_s(timeout))
-    else:
+
+    def run():
+        if algo == "local":
+            return None
+        if algo == "star":
+            _coord(state.coordinator, "rendezvous", state.key(seq),
+                   state.rank, None, None, _timeout_s(timeout))
+            return None
         key, deadline = state.key(seq), _deadline(timeout)
         token = np.zeros(1, dtype=np.uint8)
         total = _tree_reduce(state, token, SUM, key, deadline, "barrier")
         _tree_bcast_small(state, total, 0, key, deadline, "barrier")
+        return None
+
+    _run_op(state, "barrier", algo, seq, 0, run)
     _observe("barrier", group_name, 0, t0)
 
 
@@ -1269,16 +1371,24 @@ def send(tensor, dst_rank: int, group_name: str = "default",
     state.send_seq[(dst_rank, tag)] = seq + 1
     arr = _to_numpy(tensor)
     t0 = time.monotonic()
-    if state.use_p2p:
-        _send(state, dst_rank,
-              (state.name, state.epoch, "p2p", state.rank, dst_rank,
-               tag, seq), arr, "send")
-        # ``arr`` aliases the caller's tensor (zero-copy); send() must
-        # not return while it can still be pickled later by a drainer
-        coll_transport.flush()
-    else:
-        get(state.coordinator.post.remote(
-            dst_rank, (state.rank, tag, seq), arr))
+    okey = ("p2p", state.rank, dst_rank, tag, seq)
+
+    def run():
+        if state.use_p2p:
+            _send(state, dst_rank,
+                  (state.name, state.epoch, "p2p", state.rank, dst_rank,
+                   tag, seq), arr, "send")
+            # ``arr`` aliases the caller's tensor (zero-copy); send()
+            # must not return while it can still be pickled later by a
+            # drainer
+            coll_transport.flush()
+        else:
+            get(state.coordinator.post.remote(
+                dst_rank, (state.rank, tag, seq), arr))
+        return None
+
+    _run_op(state, "send", "p2p" if state.use_p2p else "star", okey,
+            arr.nbytes, run)
     _observe("send", group_name, arr.nbytes, t0)
 
 
@@ -1290,14 +1400,19 @@ def recv(src_rank: int, group_name: str = "default", tag: int = 0,
     seq = state.recv_seq.get((src_rank, tag), 0)
     state.recv_seq[(src_rank, tag)] = seq + 1
     t0 = time.monotonic()
-    if state.use_p2p:
-        data = coll_transport.wait(
-            (state.name, state.epoch, "p2p", src_rank, state.rank,
-             tag, seq), _deadline(timeout), what="p2p recv")
-        arr = np.array(data)
-    else:
-        arr = np.asarray(_coord(state.coordinator, "take", state.rank,
-                                (src_rank, tag, seq),
-                                _timeout_s(timeout)))
+    okey = ("p2p", src_rank, state.rank, tag, seq)
+
+    def run():
+        if state.use_p2p:
+            data = coll_transport.wait(
+                (state.name, state.epoch, "p2p", src_rank, state.rank,
+                 tag, seq), _deadline(timeout), what="p2p recv")
+            return np.array(data)
+        return np.asarray(_coord(state.coordinator, "take", state.rank,
+                                 (src_rank, tag, seq),
+                                 _timeout_s(timeout)))
+
+    arr = _run_op(state, "recv", "p2p" if state.use_p2p else "star",
+                  okey, 0, run)
     _observe("recv", group_name, arr.nbytes, t0)
     return arr

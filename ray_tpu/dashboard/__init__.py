@@ -283,6 +283,12 @@ class _Handler(JsonHandler):
                 # surface); handler threads may block for the fan-out
                 return self._json(200,
                                   {"stacks": node.cluster_stacks(3.0)})
+            if path == "/api/collectives":
+                # flight-recorder surface (the `rtpu coll-debug`
+                # equivalent): in-flight watermarks + hang verdicts;
+                # handler threads may block for the fan-out
+                return self._json(
+                    200, {"collectives": node.collective_health(2.0)})
             if path.startswith("/api/task/"):
                 # drill-down: every recorded state transition of one
                 # task (id or unique hex prefix), time-ordered

@@ -199,6 +199,51 @@ def cmd_profile(client, args) -> None:
         print(f"wrote Chrome trace to {args.chrome}")
 
 
+def cmd_coll_debug(client, args) -> None:
+    """Collective flight-recorder surface: in-flight op watermarks
+    across every rank, hang verdicts (dead rank / lost chunk / lagging
+    rank), and optionally the raw recent event ring per process."""
+    from ..state import collective_health, flight_records
+    report = collective_health(timeout_s=args.timeout)
+    if args.format == "json":
+        if args.records:
+            report = {**report, "records": flight_records(args.timeout)}
+        print(json.dumps(report, default=str, indent=2))
+        return
+    ops = report.get("ops") or []
+    verdicts = report.get("verdicts") or []
+    print(f"{report.get('processes', 0)} process(es) replied, "
+          f"{len(ops)} collective op(s) observed, "
+          f"{len(verdicts)} stuck")
+    for op in ops:
+        state = "STUCK" if op.get("stuck_ranks") else "done"
+        print(f"\n=== {op.get('op')} group={op.get('group')} "
+              f"seq={op.get('seq')} algo={op.get('algo')} "
+              f"nbytes={op.get('nbytes')} [{state}] "
+              f"({len(op.get('done_ranks') or [])}/{op.get('world')} "
+              "ranks finished)")
+        for rank, mark in sorted((op.get("stuck_ranks") or {}).items()):
+            print(f"    rank {rank}: {mark}")
+    for v in verdicts:
+        print(f"\n!!! [{v.get('verdict')}] {v.get('message')}")
+        for fr in v.get("stack") or []:
+            print(f"        {fr}")
+    if args.records:
+        recs = flight_records(args.timeout)
+        for node_hex, snaps in sorted(
+                (recs.get("nodes") or {}).items()):
+            for snap in snaps or []:
+                recent = snap.get("recent") or []
+                if not recent:
+                    continue
+                print(f"\n--- {snap.get('kind')} "
+                      f"{str(snap.get('worker_id'))[:12]} on "
+                      f"{node_hex}: last {len(recent)} event(s)")
+                for ev in recent[-args.limit:]:
+                    print(f"    {ev.get('ts'):.6f} {ev.get('kind'):8s} "
+                          f"{ev.get('key')} ({ev.get('info')})")
+
+
 def cmd_doctor(client, args) -> None:
     """Correlated cluster health report: nodes, resources, task/actor
     rollups, stall diagnoses, recent alerts, telemetry highlights."""
@@ -223,6 +268,8 @@ def cmd_doctor(client, args) -> None:
         print(f"telemetry: {json.dumps(rep['metrics'])}")
     for ev in rep["stalls"]:
         print(f"  STALL [{ev.get('cause')}] {ev.get('message')}")
+    for v in (rep.get("collectives") or {}).get("verdicts", []):
+        print(f"  COLLECTIVE [{v.get('verdict')}] {v.get('message')}")
     for ev in rep["alerts"]:
         print(f"  {ev.get('severity')} [{ev.get('label')}] "
               f"{ev.get('message')}")
@@ -404,6 +451,18 @@ def main(argv=None) -> None:
                            help="correlated cluster health report")
     p_doc.add_argument("--format", choices=("text", "json"),
                        default="text")
+    p_coll = sub.add_parser("coll-debug",
+                            help="collective flight recorder: watermark"
+                            " diff + hang/straggler verdicts")
+    p_coll.add_argument("--timeout", type=float, default=3.0)
+    p_coll.add_argument("--records", action="store_true",
+                        help="also dump each process's recent "
+                        "flight-recorder event ring")
+    p_coll.add_argument("--limit", type=int, default=40,
+                        help="ring events shown per process with "
+                        "--records")
+    p_coll.add_argument("--format", choices=("text", "json"),
+                        default="text")
 
     p_start = sub.add_parser("start", help="start a cluster node process")
     p_start.add_argument("--head", action="store_true")
@@ -472,7 +531,8 @@ def main(argv=None) -> None:
         {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
          "memory": cmd_memory, "timeline": cmd_timeline,
          "metrics": cmd_metrics, "stack": cmd_stack,
-         "profile": cmd_profile, "doctor": cmd_doctor}[args.command](
+         "profile": cmd_profile, "doctor": cmd_doctor,
+         "coll-debug": cmd_coll_debug}[args.command](
              client, args)
     finally:
         try:

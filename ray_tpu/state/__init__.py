@@ -8,6 +8,8 @@ records straight from the control plane.
 
 from .api import (  # noqa: F401
     cluster_stacks,
+    collective_health,
+    flight_records,
     health_report,
     list_actors,
     list_jobs,

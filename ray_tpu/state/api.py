@@ -261,6 +261,25 @@ def profile(duration_s: float = 5.0, interval_ms: Optional[float] = None,
     return report
 
 
+def collective_health(timeout_s: float = 2.0) -> dict:
+    """Cluster-wide collective hang & straggler diagnosis (the flight-
+    recorder surface): every rank's per-op watermarks plus verdicts for
+    stuck ops — ``dead_rank`` (process answered nothing), ``lost_chunk``
+    (sender logged the send, receiver never saw the delivery — the edge
+    is named) or ``lagging_rank`` (lowest watermark, with its current
+    stack attached when a dump matches). Returns
+    ``{"ops": [...], "verdicts": [...], "processes": n}``."""
+    return _ctx.require_client().collective_health(timeout_s) or {}
+
+
+def flight_records(timeout_s: float = 2.0) -> dict:
+    """Raw per-process collective flight-recorder snapshots: the recent
+    event ring (send/deliver/recv per chunk with monotonic timestamps)
+    and completed-op records, keyed by node —
+    ``{"nodes": {node_hex: [snapshot, ...]}}``."""
+    return _ctx.require_client().flight_records(timeout_s) or {}
+
+
 def health_report() -> Dict[str, Any]:
     """`rtpu doctor`: one correlated cluster health view — node/resource
     state, task/actor rollups, stall diagnoses, recent WARNING/ERROR
@@ -287,6 +306,11 @@ def health_report() -> Dict[str, Any]:
     alerts = [e for e in recent
               if e.get("severity") in ("WARNING", "ERROR")
               and e.get("label") != "TASK_STALL"]
+    try:
+        coll = collective_health(1.5)
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        coll = {}
+    coll_verdicts = coll.get("verdicts") or []
 
     highlights: Dict[str, Any] = {}
     try:
@@ -321,6 +345,9 @@ def health_report() -> Dict[str, Any]:
     if n_pending and cpu_avail <= 0:
         problems.append(f"{n_pending} task(s) pending with 0 CPU "
                         "available (saturated or wedged)")
+    if coll_verdicts:
+        problems.append(f"{len(coll_verdicts)} stuck collective op(s) "
+                        "— see collectives")
     return {
         "healthy": not problems,
         "problems": problems,
@@ -331,6 +358,8 @@ def health_report() -> Dict[str, Any]:
         "actors": actor_summary,
         "stalls": stalls[-20:],
         "alerts": alerts[-20:],
+        "collectives": {"ops": coll.get("ops") or [],
+                        "verdicts": coll_verdicts},
         "metrics": highlights,
     }
 
@@ -382,10 +411,61 @@ def trace_timeline(filename: Optional[str] = None) -> Any:
     return trace
 
 
+def _collective_trace_events() -> List[dict]:
+    """Completed collective ops from every process's flight recorder as
+    Chrome-trace X events: one span per (rank, call), grouped per
+    collective group so straggling ranks line up visually against their
+    peers. Best-effort — a session with the recorder off (or no runtime)
+    contributes nothing. Collective-free sessions skip the cluster
+    fan-out entirely: a plain-task timeline must not pay a COLL_PROGRESS
+    round trip to every process for an empty result."""
+    from .._private import flight_recorder as _fr
+    try:
+        local_active = bool(_fr._groups or _fr._done or _fr._inflight)
+        if not local_active:
+            # ranks may live only in workers: the merged metrics table
+            # (one STATE_QUERY) says whether ANY process ran collectives
+            # (workers flush telemetry at task boundaries)
+            counters = (_query("metrics") or {}).get("counters") or {}
+            if not any(name == "rtpu_collective_ops_total"
+                       for name, _tags in counters):
+                return []
+        records = flight_records(timeout_s=1.5)
+    except Exception:   # noqa: BLE001 — timeline degrades, never dies
+        return []
+    trace: List[dict] = []
+    for snaps in (records.get("nodes") or {}).values():
+        for snap in snaps or []:
+            for rec in snap.get("done", ()):
+                start = rec.get("start")
+                dur = rec.get("dur")
+                if start is None or dur is None:
+                    continue
+                trace.append({
+                    "name": f"coll::{rec.get('op')}",
+                    "cat": "collective",
+                    "ph": "X",
+                    "ts": start * 1e6,
+                    "dur": max(dur, 1e-6) * 1e6,
+                    "pid": f"coll:{rec.get('group')}",
+                    "tid": f"rank:{rec.get('rank')}",
+                    "args": {"op": rec.get("op"),
+                             "algo": rec.get("algo"),
+                             "seq": rec.get("key"),
+                             "nbytes": rec.get("nbytes"),
+                             "world": rec.get("world"),
+                             "chunks_sent": rec.get("sent"),
+                             "chunks_recv": rec.get("recv"),
+                             "error": rec.get("error")},
+                })
+    return trace
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Chrome-trace JSON of task execution (reference: ``ray.timeline``,
-    ``_private/state.py:865``). Load the output in chrome://tracing or
-    Perfetto."""
+    ``_private/state.py:865``), plus one span per completed collective
+    call from the flight recorder (``cat: collective``, one row per
+    rank). Load the output in chrome://tracing or Perfetto."""
     events = _query("tasks") or []
     # pair RUNNING -> FINISHED/FAILED per task
     runs: Dict[Any, dict] = {}
@@ -409,6 +489,7 @@ def timeline(filename: Optional[str] = None) -> Any:
                         else str(tid)),
                 "args": {"state": ev["state"]},
             })
+    trace.extend(_collective_trace_events())
     if filename is not None:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -238,6 +238,24 @@ def _make_ring_worker():
             x = np.full(n, float(col.get_rank(group) + 1), np.float32)
             return col.allreduce(x, group_name=group)
 
+        def guarded_allreduce(self, n, timeout):
+            """allreduce that reports its failure instead of raising
+            (hang-diagnosis tests inspect the TimeoutError message)."""
+            x = np.ones(n, np.float32)
+            try:
+                col.allreduce(x, timeout=timeout)
+                return ("ok", "")
+            except Exception as exc:       # noqa: BLE001
+                return ("err", str(exc))
+
+        def inflight_gauge(self):
+            from ray_tpu._private import telemetry
+            snap = telemetry.snapshot_local()
+            val = snap["gauges"].get(
+                ("rtpu_collective_inflight_chunks", ()))
+            return (val[0] if val else 0.0,
+                    coll_transport.stats()["pending"])
+
     return Ring
 
 
@@ -311,6 +329,79 @@ def test_rank_death_surfaces_timeout_everywhere(rtpu_init):
             raise AssertionError("survivor completed against a dead rank")
         except Exception as exc:                 # noqa: BLE001
             assert "timed out" in str(exc).lower(), exc
+
+
+def test_hang_diagnosis_names_dead_rank(rtpu_init):
+    """ISSUE 10 acceptance: an injected hang (one rank killed) is
+    diagnosed within the collective timeout — ``collective_health()``
+    names the guilty rank, the op, and the phase, and the TimeoutError
+    every survivor raises carries the verdict in its message."""
+    import time as _time
+
+    from ray_tpu import state as rstate
+    from ray_tpu.comm import collective as col
+    Ring = _make_ring_worker()
+    members = [Ring.remote() for _ in range(3)]
+    col.create_collective_group(members, 3, [0, 1, 2])
+    ray_tpu.kill(members[2])
+    refs = [m.guarded_allreduce.remote(500_000, 8.0)
+            for m in members[:2]]
+    # while the survivors are wedged inside the allreduce, the driver's
+    # cluster-wide diagnosis must already name the dead rank
+    verdict = None
+    deadline = _time.monotonic() + 7.0
+    while _time.monotonic() < deadline:
+        rep = rstate.collective_health(2.0)
+        dead = [v for v in rep.get("verdicts", ())
+                if v.get("verdict") == "dead_rank"]
+        if dead:
+            verdict = dead[0]
+            break
+        _time.sleep(0.25)
+    assert verdict is not None, "diagnosis never named the dead rank"
+    assert verdict["rank"] == 2
+    assert verdict["op"] == "allreduce"
+    assert verdict.get("phase")            # e.g. "rs" — the stuck hop
+    # and every survivor's TimeoutError carries the same verdict
+    for status, msg in ray_tpu.get(refs, timeout=60):
+        assert status == "err"
+        assert "timed out" in msg.lower(), msg
+        assert "dead rank 2" in msg and "allreduce" in msg, msg
+
+
+def test_inflight_gauge_drops_on_timeout(rtpu_init):
+    """Satellite regression: chunks delivered for a call that later
+    times out must leave the mailbox WITH the failure — the
+    ``rtpu_collective_inflight_chunks`` gauge returns to 0 when the
+    TimeoutError is handled, not ``collective_call_ttl_s`` later."""
+    import pytest
+
+    from ray_tpu._private import coll_transport, telemetry
+    from ray_tpu.comm import collective as col
+    Ring = _make_ring_worker()
+    peer = Ring.remote()
+    join = peer._rtpu_init_collective.remote(2, 1, "leak")
+    col.init_collective_group(2, 0, group_name="leak")
+    ray_tpu.get(join)
+    ray_tpu.kill(peer)                 # rank 1 dies before the call
+    state = col._groups()["leak"]
+
+    def gauge():
+        snap = telemetry.snapshot_local()
+        val = snap["gauges"].get(("rtpu_collective_inflight_chunks", ()))
+        return val[0] if val else 0.0
+
+    # a chunk delivered for the doomed call seq 0 strands in this
+    # process's mailbox (no waiter will ever consume a seg-99 key)
+    coll_transport.deposit((state.name, state.epoch, 0, "rs", 99, 0),
+                           np.ones(4, np.float32))
+    assert gauge() >= 1.0
+    with pytest.raises(TimeoutError):
+        col.allreduce(np.ones(300_000, np.float32), group_name="leak",
+                      timeout=2.0)
+    assert gauge() == 0.0
+    assert coll_transport.stats()["pending"] == 0
+    col.destroy_collective_group("leak")
 
 
 def test_driver_as_rank(rtpu_init):

@@ -167,6 +167,63 @@ def test_stall_detector_diagnoses_causes():
         ray_tpu.shutdown()
 
 
+def test_stall_detector_collective_stuck_cause():
+    """ISSUE 10 satellite: a worker parked in a collective wait past
+    ``collective_timeout_s / 2`` gets a TASK_STALL event with the
+    ``collective_stuck`` cause, carrying the flight-recorder diagnosis
+    (the lagging rank's id) in its message — long before the generic
+    300s RUNNING threshold."""
+    ray_tpu.init(num_cpus=4, _system_config={
+        "stall_detector_interval_s": 0.3,
+        # head-process view: probe RUNNING tasks after timeout/2 = 1s
+        "collective_timeout_s": 2.0,
+    })
+    try:
+        from ray_tpu.comm import collective as col
+
+        @ray_tpu.remote(num_cpus=0)
+        class Rank(col.CollectiveActorMixin):
+            def allreduce_now(self, n, timeout):
+                import numpy as np
+                return float(col.allreduce(np.ones(n, "float32"),
+                                           timeout=timeout)[0])
+
+            def allreduce_late(self, n, delay, timeout):
+                import numpy as np
+                time.sleep(delay)
+                return float(col.allreduce(np.ones(n, "float32"),
+                                           timeout=timeout)[0])
+
+        members = [Rank.remote() for _ in range(2)]
+        col.create_collective_group(members, 2, [0, 1])
+        # rank 0 enters immediately and wedges on rank 1, which joins
+        # 8s late — long enough for the sweep to flag the hang, short
+        # enough that the test ends cleanly with a completed allreduce
+        r0 = members[0].allreduce_now.remote(500_000, 30.0)
+        r1 = members[1].allreduce_late.remote(500_000, 8.0, 30.0)
+
+        def stuck_events():
+            return [e for e in sapi.list_cluster_events()
+                    if e.get("label") == "TASK_STALL"
+                    and e.get("cause") == "collective_stuck"] or None
+
+        evs = _poll(stuck_events, timeout=12.0)
+        assert evs, [
+            (e.get("cause"), e.get("message"))
+            for e in sapi.list_cluster_events()
+            if e.get("label") == "TASK_STALL"]
+        ev = evs[-1]
+        assert ev["severity"] == "WARNING"
+        assert "collective wait" in ev["message"]
+        # the diagnoser's verdict rides along: rank 1 is the laggard
+        assert "lagging rank 1" in ev["message"], ev["message"]
+        assert ev["task_name"].endswith("allreduce_now")
+        # the hang resolves once rank 1 arrives
+        assert ray_tpu.get([r0, r1], timeout=60) == [2.0, 2.0]
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_stall_slow_producer_then_doctor_recovers():
     """A dep whose producer is alive-but-slow is diagnosed as upstream
     slowness (not object loss), and once everything completes the

@@ -523,6 +523,56 @@ def test_cross_node_hierarchical_collective(tcp_cluster):
     assert q8 * 2 <= hier, (q8, hier)
 
 
+def test_cross_node_hang_diagnosis_names_dead_rank(tcp_cluster):
+    """ISSUE 10 acceptance across OS-isolated nodes: SIGKILL one rank
+    mid-allreduce and, within the collective timeout,
+    ``state.collective_health()`` (the ``rtpu doctor``/``coll-debug``
+    backend) must name the dead rank and the op — and the TimeoutError
+    on every survivor must carry the verdict."""
+    from ray_tpu import state as rstate
+    from ray_tpu.comm import collective as col
+
+    tcp_cluster.add_node(num_cpus=2, resources={"side": 2.0})
+    _wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=0)
+    class Rank(col.CollectiveActorMixin):
+        def guarded_allreduce(self, n, timeout):
+            x = np.ones(n, np.float32)
+            try:
+                col.allreduce(x, timeout=timeout)
+                return ("ok", "")
+            except Exception as exc:       # noqa: BLE001
+                return ("err", str(exc))
+
+    members = ([Rank.remote() for _ in range(2)]
+               + [Rank.options(resources={"side": 1.0}).remote()
+                  for _ in range(2)])
+    col.create_collective_group(members, 4, [0, 1, 2, 3])
+    # ranks 0-2 enter a 4 MB allreduce; rank 3 (on the second OS node)
+    # never joins it and is SIGKILLed while the others are mid-op
+    refs = [m.guarded_allreduce.remote(1_048_576, 12.0)
+            for m in members[:3]]
+    time.sleep(0.5)
+    ray_tpu.kill(members[3])
+    verdict = None
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        rep = rstate.collective_health(2.0)
+        dead = [v for v in rep.get("verdicts", ())
+                if v.get("verdict") == "dead_rank"]
+        if dead:
+            verdict = dead[0]
+            break
+        time.sleep(0.3)
+    assert verdict is not None, "diagnosis never named the dead rank"
+    assert verdict["rank"] == 3
+    assert verdict["op"] == "allreduce"
+    for status, msg in ray_tpu.get(refs, timeout=90):
+        assert status == "err"
+        assert "dead rank 3" in msg and "allreduce" in msg, msg
+
+
 def test_cross_node_ring_collective(tcp_cluster):
     """Ring collective whose chunks actually cross the wire: one rank
     per OS-isolated node, payload above the tree threshold, so every

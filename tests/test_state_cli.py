@@ -53,14 +53,25 @@ def golden_task():
 def test_timeline_golden_file(rtpu_init, tmp_path):
     """Golden-file pin of the ``state.timeline()`` Chrome-trace JSON:
     event shape (name/cat/ph/args) byte-exact, variable fields (ts, dur,
-    node/task ids) normalized after type/positivity checks. Complements
-    the span-based ``trace_timeline`` coverage in
+    node/task ids) normalized after type/positivity checks. Includes a
+    collective flight-recorder span (ISSUE 10: completed collective
+    calls render as ``cat: collective`` events, one row per rank).
+    Complements the span-based ``trace_timeline`` coverage in
     ``test_tracing_events.py``."""
     import os
 
+    import numpy as np
+
+    from ray_tpu.comm import collective as col
+
     ray_tpu.get([golden_task.remote() for _ in range(2)])
+    # a world-1 collective on the driver: its flight-recorder record
+    # must show up as a deterministic `coll::allreduce` span
+    col.init_collective_group(1, 0, group_name="tl")
+    col.allreduce(np.ones(8, np.float32), group_name="tl")
     out = str(tmp_path / "trace.json")
     assert rstate.timeline(out) == out
+    col.destroy_collective_group("tl")
     with open(out) as f:
         trace = json.load(f)
 
@@ -68,12 +79,18 @@ def test_timeline_golden_file(rtpu_init, tmp_path):
     for ev in sorted(trace, key=lambda e: (e["name"], e["ts"])):
         assert isinstance(ev["ts"], float) and ev["ts"] > 0
         assert isinstance(ev["dur"], float) and ev["dur"] > 0
-        assert ev["pid"].startswith("node:")
+        if ev["cat"] == "collective":
+            assert ev["pid"].startswith("coll:")
+            pid = ev["pid"]                     # group name: literal
+        else:
+            assert ev["pid"].startswith("node:")
+            pid = "node:<node>"
         normalized.append({
             "name": ev["name"].rsplit(".", 1)[-1],
             "cat": ev["cat"], "ph": ev["ph"],
             "ts": "<ts>", "dur": "<dur>",
-            "pid": "node:<node>", "tid": "<tid>",
+            "pid": pid,
+            "tid": ev["tid"] if ev["cat"] == "collective" else "<tid>",
             "args": ev["args"],
         })
     golden_path = os.path.join(os.path.dirname(__file__), "golden",
