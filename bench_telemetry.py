@@ -377,6 +377,30 @@ def hierarchical_ab() -> dict:
         cluster.shutdown()
 
 
+def callsite_ab(nop) -> tuple:
+    """Provenance-capture overhead gate (ISSUE 11): the submission hot
+    path with ``object_callsite_enabled`` on vs off, INTERLEAVED and
+    compared at the per-arm MEDIAN (same harness as ``recorder_ab``).
+    Per .remote() the capture is a few ``f_back`` hops + one buffered
+    tuple against a ~ms round trip, so the honest ratio is ~1.0; the
+    < 1.05 budget trips on a structural regression (a per-ref RPC, an
+    inspect.stack() walk), not noise. Returns (on_s, off_s)."""
+    import statistics as _st
+
+    burst = 400
+    times = {True: [], False: []}
+    try:
+        for _ in range(7):
+            for enabled in (False, True):
+                CONFIG._values["object_callsite_enabled"] = enabled
+                t0 = time.perf_counter()
+                ray_tpu.get([nop.remote() for _ in range(burst)])
+                times[enabled].append(time.perf_counter() - t0)
+    finally:
+        CONFIG._values["object_callsite_enabled"] = True
+    return _st.median(times[True]), _st.median(times[False])
+
+
 def async_dispatch_ab(nop) -> tuple:
     """Same-box A/B of worker-lease pipelining: a tiny-task submit burst
     with the shipped ``worker_pipeline_depth`` vs depth 1 (leases off).
@@ -504,10 +528,16 @@ def main() -> None:
         # 1.05 only trips when pipelining stops helping or regresses.
         dispatch_piped_s, dispatch_d1_s = async_dispatch_ab(nop)
         dispatch_ratio = dispatch_piped_s / max(dispatch_d1_s, 1e-9)
+        # callsite-capture gate: provenance on vs off on the submission
+        # hot path, interleaved medians (< 1.05 — the ISSUE 11 bound;
+        # the per-call cost is a few frame hops + a buffered tuple)
+        callsite_on_s, callsite_off_s = callsite_ab(nop)
+        callsite_ratio = callsite_on_s / max(callsite_off_s, 1e-9)
         ok = (submit_ratio < 1.2 and put_ratio < 1.2 and ns < 20_000
               and profile_ratio < 1.4 and prof_samples > 0
               and transport_ratio < 1.75 and collective_ratio < 0.9
-              and dispatch_ratio < 1.05 and recorder_ratio < 1.05)
+              and dispatch_ratio < 1.05 and recorder_ratio < 1.05
+              and callsite_ratio < 1.05)
         payload = {
             "metric": "telemetry_overhead",
             "submit_on_s": round(sub_on, 4),
@@ -533,6 +563,9 @@ def main() -> None:
             "dispatch_pipelined_s": round(dispatch_piped_s, 4),
             "dispatch_depth1_s": round(dispatch_d1_s, 4),
             "dispatch_ratio": round(dispatch_ratio, 3),
+            "callsite_on_s": round(callsite_on_s, 4),
+            "callsite_off_s": round(callsite_off_s, 4),
+            "callsite_ratio": round(callsite_ratio, 3),
         }
     finally:
         ray_tpu.shutdown()

@@ -11,6 +11,8 @@ loaded zero-copy through ``ObjectReader``.
 from __future__ import annotations
 
 import hashlib
+import os
+import sys
 import threading
 from collections import deque
 from concurrent.futures import Future
@@ -18,8 +20,10 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions
+from . import context as _ctx
 from . import locksan
 from . import protocol as P
+from . import telemetry
 from .config import CONFIG
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .object_ref import ObjectRef, ObjectRefGenerator
@@ -32,6 +36,35 @@ def _flat_bytes(smeta, views, total: int) -> bytes:
     out = bytearray(total)
     ser.write_to(memoryview(out), smeta, views)
     return bytes(out)
+
+
+# creation-callsite capture (reference analogue: the ReferenceCounter's
+# per-ref callsites behind RAY_record_ref_creation_sites): the frame
+# walk skips everything inside the ray_tpu package so a data-plane
+# helper's internal put() is attributed to the user line that drove it
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def _callsite() -> str:
+    """``dir/file.py:line`` of the nearest frame outside ray_tpu — a few
+    ``f_back`` hops on the hot path; no ``inspect.stack()``, no file IO.
+    Falls back to the innermost non-package-rooted form for calls with
+    no user frame (runtime-internal puts)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_ROOT):
+            parts = fn.split(os.sep)
+            return f"{os.sep.join(parts[-2:])}:{f.f_lineno}"
+        f = f.f_back
+    return "<internal>"
+
+
+def _creator_label() -> str:
+    """Who is creating the object: the running task/actor-method name in
+    a worker, else the driver."""
+    name = _ctx.current_task_name
+    return name if name else "driver"
 
 
 class CoreClient:
@@ -77,6 +110,10 @@ class CoreClient:
         self._ref_lock = locksan.lock("client.ref")
         self._edge_flush_lock = locksan.lock("client.edge_flush")
         self._pending_decrs: "deque[ObjectID]" = deque()
+        # creation provenance records (oid, callsite, creator), buffered
+        # beside the edge stream and shipped as one OBJ_PROVENANCE frame
+        # per flush (empty forever when object_callsite_enabled=0)
+        self._prov_buf: List[tuple] = []
         # ordered edge stream, coalesced into one REF_BATCH frame — one
         # socket write per ~batch of submissions instead of one per ref.
         # Delayed registration is safe: an object only becomes freeable
@@ -115,6 +152,19 @@ class CoreClient:
         # GC-safe: deque.append is atomic and takes no lock
         self._pending_decrs.append(oid)
 
+    def _note_provenance(self, oids: Sequence[ObjectID]) -> None:
+        """Record the creation callsite for freshly-minted object ids
+        (puts, task/actor-call returns, actor creation returns). One
+        frame walk per call covers the whole id batch."""
+        if not oids or not CONFIG.object_callsite_enabled:
+            return
+        cs = _callsite()
+        creator = _creator_label()
+        with self._ref_lock:
+            for oid in oids:
+                self._prov_buf.append((oid, cs, creator))
+        telemetry.counter_inc(telemetry.M_OBJ_CALLSITES, float(len(oids)))
+
     def _apply_decrs_locked(self) -> None:
         while True:
             try:
@@ -145,12 +195,23 @@ class CoreClient:
         with self._edge_flush_lock:
             with self._ref_lock:
                 self._apply_decrs_locked()
-                if not self._edge_buf or self._closed.is_set():
+                if self._closed.is_set():
                     self._edge_buf.clear()
+                    self._prov_buf.clear()
                     return
                 batch, self._edge_buf = self._edge_buf, []
+                prov, self._prov_buf = self._prov_buf, []
+            if batch:
+                try:
+                    self._send(P.REF_BATCH, batch)  # lint: allow-under-lock(edge_flush exists to serialize take-and-send; FIFO wire order is the invariant)
+                except OSError:
+                    pass
+        if prov:
+            # provenance is order-independent of the edge stream (a
+            # pure per-oid attribution table), so it ships OUTSIDE the
+            # flush lock — no new blocking work under any lock
             try:
-                self._send(P.REF_BATCH, batch)  # lint: allow-under-lock(edge_flush exists to serialize take-and-send; FIFO wire order is the invariant)
+                self._send(P.OBJ_PROVENANCE, prov)
             except OSError:
                 pass
 
@@ -170,7 +231,7 @@ class CoreClient:
                 self.flush_submissions()
             except OSError:
                 pass
-            if self._pending_decrs or self._edge_buf:
+            if self._pending_decrs or self._edge_buf or self._prov_buf:
                 self.flush_refs()
         try:
             self.flush_submissions()
@@ -429,6 +490,7 @@ class CoreClient:
         # the ref exists (and is registered) BEFORE any contained-ref
         # pin references it as holder — see _pin_contained below
         ref = ObjectRef(oid)
+        self._note_provenance((oid,))
         begin_ref_capture()
         try:
             if self.wire_data_plane:
@@ -737,6 +799,7 @@ class CoreClient:
         # lag behind a writer looping over f.remote(big_array).
         oid = ObjectID.for_put(self.worker_id)
         implicit_ref = ObjectRef(oid)       # holder for _pin_contained
+        self._note_provenance((oid,))
         self._pin_contained(oid, contained)
         if self.wire_data_plane:
             self._wire_put(oid, _flat_bytes(smeta, views, total), total)
@@ -779,6 +842,7 @@ class CoreClient:
             namespace=self._active_namespace(),
             runtime_env=runtime_env,
             trace_context=self._trace_context())
+        self._note_provenance(return_ids)
         self._send_submission(P.SUBMIT_TASK, spec)
         if streaming:
             return ObjectRefGenerator(task_id)
@@ -793,6 +857,8 @@ class CoreClient:
         self._send(P.PROFILE_EVENT, (kind, payload))
 
     def create_actor(self, spec: P.ActorSpec) -> None:
+        if spec.creation_return_id is not None:
+            self._note_provenance((spec.creation_return_id,))
         self._send(P.CREATE_ACTOR, spec)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
@@ -813,6 +879,7 @@ class CoreClient:
             owner_id=self.worker_id.binary(),
             namespace=self._active_namespace(),
             trace_context=self._trace_context())
+        self._note_provenance(return_ids)
         self._send_submission(P.SUBMIT_ACTOR_TASK, spec)
         if streaming:
             return ObjectRefGenerator(task_id)

@@ -114,6 +114,28 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                  "retries for tasks killed by the memory "
                                  "monitor, counted separately from "
                                  "max_retries (reference: task_oom_retries)"),
+    # --- object ownership & memory introspection ---
+    "object_callsite_enabled": (bool, True,
+                                "record a creation callsite (file:line + "
+                                "task/actor name) per put()/.remote() "
+                                "return and ship it with ref "
+                                "registration; powers state.memory_"
+                                "summary(), `rtpu memory` attribution "
+                                "and the OOM autopsy (reference: "
+                                "RAY_record_ref_creation_sites). Off = "
+                                "the submission hot path is exactly the "
+                                "pre-provenance code"),
+    "memory_leak_sweep_interval_s": (float, 10.0,
+                                     "control-plane object-leak sweep "
+                                     "period: flags objects whose only "
+                                     "ref holders live on dead nodes, or "
+                                     "that sat pinned with zero holders "
+                                     "past the TTL; 0 disables"),
+    "memory_leak_pinned_ttl_s": (float, 120.0,
+                                 "an object with zero ref holders that "
+                                 "stays pinned (task arg / contained "
+                                 "pin) longer than this is flagged as a "
+                                 "suspected leak by the sweep"),
     # --- health / failure ---
     "heartbeat_period_ms": (int, 1000,
                             "resource-view sync cadence: liveness pings "

@@ -228,6 +228,14 @@ class GlobalControlPlane:
         # object survives the gap between the producer's locals dying
         # and a consumer deserializing the return
         self._contained_pins: Dict[ObjectID, List[ObjectID]] = {}
+        # RETURN_REFS that arrived before the submitter's REF_REGISTER
+        # of the holder (a fast task's worker conn can outrun the
+        # driver's buffered edge flush): parked — NOT pinned — until
+        # the holder registers, then promoted to a real contained pin.
+        # holder_oid -> (oids, parked_at); TTL-swept so a
+        # fire-and-forget holder whose register never comes can't
+        # accumulate records
+        self._contained_pending: Dict[ObjectID, tuple] = {}
         # zero-count objects in their free-grace window (oid -> deadline;
         # see _schedule_zero_locked)
         self._zero_pending: Dict[ObjectID, float] = {}
@@ -249,6 +257,17 @@ class GlobalControlPlane:
         # per task (re-warn only when the diagnosed cause changes)
         self._stall_last_sweep = 0.0
         self._stall_warned: Dict[TaskID, str] = {}
+        # object provenance: oid -> (callsite, creator) captured at
+        # put()/.remote() time (reference: ReferenceCounter callsites
+        # behind RAY_record_ref_creation_sites); dies with the object
+        self.obj_provenance: Dict[ObjectID, tuple] = {}
+        # leak-sweep state: current findings, first-seen time of
+        # zero-holder-but-pinned objects, and the cause already warned
+        # per object (emit-once until the cause changes)
+        self._leaks: Dict[ObjectID, dict] = {}
+        self._pinned_zero_since: Dict[ObjectID, float] = {}
+        self._leak_warned: Dict[ObjectID, str] = {}
+        self._leak_last_sweep = 0.0
         self._restore()
 
     # ------------------------------------------------------- persistence
@@ -653,6 +672,12 @@ class GlobalControlPlane:
             # a borrow landed during the zero-grace window: cancel the
             # pending free (see _schedule_zero_locked)
             self._zero_pending.pop(oid, None)
+            pend = self._contained_pending.pop(oid, None)
+            if pend is not None:
+                # a RETURN_REFS raced ahead of this register (see
+                # pin_contained): promote the parked containment now
+                # that the holder is live
+                self._pin_contained_locked(oid, pend[0])
 
     def ref_drop(self, oid: ObjectID, holder: tuple) -> None:
         with self._lock:
@@ -746,17 +771,28 @@ class GlobalControlPlane:
         for the same return (task retry) replaces the previous pin set."""
         with self._lock:
             if self.ref_holders.get(holder_oid) is None:
-                # the return's refs already died (fire-and-forget that
-                # dropped before seal): nothing can ever read it, so the
-                # nested objects are garbage too — don't pin
+                # Two indistinguishable cases: the return's refs already
+                # died (fire-and-forget — nested objects are garbage,
+                # don't pin) OR a fast task's RETURN_REFS outran the
+                # submitter's buffered REF_REGISTER edge. Park WITHOUT
+                # pinning: a late register promotes it (see
+                # ref_register); a register that never comes is
+                # TTL-swept, so garbage stays garbage either way.
+                self._contained_pending[holder_oid] = (list(oids),
+                                                       time.time())
                 return
-            self._release_contained_locked(holder_oid)
-            self._contained_pins[holder_oid] = list(oids)
-            for oid in oids:
-                self.ref_pins[oid] = self.ref_pins.get(oid, 0) + 1
-                self._zero_pending.pop(oid, None)
+            self._pin_contained_locked(holder_oid, oids)
+
+    def _pin_contained_locked(self, holder_oid: ObjectID,
+                              oids: List[ObjectID]) -> None:
+        self._release_contained_locked(holder_oid)
+        self._contained_pins[holder_oid] = list(oids)
+        for oid in oids:
+            self.ref_pins[oid] = self.ref_pins.get(oid, 0) + 1
+            self._zero_pending.pop(oid, None)
 
     def _release_contained_locked(self, holder_oid: ObjectID) -> None:
+        self._contained_pending.pop(holder_oid, None)
         for oid in self._contained_pins.pop(holder_oid, ()):
             n = self.ref_pins.get(oid, 1) - 1
             if n <= 0:
@@ -773,6 +809,11 @@ class GlobalControlPlane:
         if holders is None or holders or self.ref_pins.get(oid, 0) > 0:
             return None
         del self.ref_holders[oid]
+        # provenance and leak-sweep state die with the object
+        self.obj_provenance.pop(oid, None)
+        self._leaks.pop(oid, None)
+        self._pinned_zero_since.pop(oid, None)
+        self._leak_warned.pop(oid, None)
         # nested refs this return carried die with it (cascading via
         # their own zero-grace)
         self._release_contained_locked(oid)
@@ -793,6 +834,175 @@ class GlobalControlPlane:
             self._freed_early.add(oid)
         return {"object_id": oid,
                 "node_id": loc[0] if loc is not None else None}
+
+    # --------------------------- object provenance & memory introspection
+    # Reference surface: ``ray memory`` — the ReferenceCounter's
+    # per-ref creation callsites (RAY_record_ref_creation_sites) plus
+    # ref-type classification (LOCAL_REFERENCE / USED_BY_PENDING_TASK /
+    # CAPTURED_IN_OBJECT / ACTOR_HANDLE / PINNED_IN_STORE). Everything
+    # here derives from state the plane already keeps (ref_holders,
+    # ref_pins, _task_arg_refs, _contained_pins, actor specs); the only
+    # new ingestion is the OBJ_PROVENANCE callsite batches.
+
+    _PROVENANCE_LIMIT = 200_000
+
+    def record_provenance(self, entries: List[tuple]) -> None:
+        """Merge one client's creation-callsite batch: (oid, callsite,
+        creator) triples. Capped so runaway id churn can't grow the
+        head without bound; the leak sweep GCs entries whose object is
+        gone."""
+        with self._lock:
+            table = self.obj_provenance
+            for oid, callsite, creator in entries:
+                if oid in table or len(table) < self._PROVENANCE_LIMIT:
+                    table[oid] = (callsite, creator)
+
+    def objects_info(self, oids: List[ObjectID]) -> Dict[ObjectID, dict]:
+        """Size + location + provenance for a batch of ids in ONE call
+        (the OOM autopsy names a victim's top objects without an RPC
+        per id)."""
+        out: Dict[ObjectID, dict] = {}
+        with self._lock:
+            for oid in oids:
+                loc = self.directory.get(oid)
+                prov = self.obj_provenance.get(oid)
+                out[oid] = {
+                    "object_id": oid,
+                    "size": loc[1].size if loc is not None else None,
+                    "node_id": loc[0] if loc is not None else None,
+                    "callsite": prov[0] if prov else None,
+                    "creator": prov[1] if prov else None,
+                }
+        return out
+
+    def memory_state(self) -> dict:
+        """One consistent snapshot of the object ledger: every object
+        the plane knows (directory entries, held refs, pinned args,
+        contained pins) with its size, creation callsite and a
+        per-holder reference-type breakdown. The raw material behind
+        ``state.list_objects()`` / ``state.memory_summary()`` /
+        ``GET /api/memory``."""
+        with self._lock:
+            task_pins: Dict[ObjectID, int] = {}
+            for oids in self._task_arg_refs.values():
+                for oid in oids:
+                    task_pins[oid] = task_pins.get(oid, 0) + 1
+            contained: Dict[ObjectID, int] = {}
+            for oids in self._contained_pins.values():
+                for oid in oids:
+                    contained[oid] = contained.get(oid, 0) + 1
+            actor_returns: Dict[ObjectID, ActorID] = {}
+            for aid, rec in self.actors.items():
+                cr = rec.spec.creation_return_id
+                if cr is not None and rec.state != ACTOR_DEAD:
+                    actor_returns[cr] = aid
+            universe = (set(self.directory) | set(self.ref_holders)
+                        | set(task_pins) | set(contained))
+            rows: List[dict] = []
+            for oid in universe:
+                loc = self.directory.get(oid)
+                prov = self.obj_provenance.get(oid)
+                holders = self.ref_holders.get(oid) or ()
+                ref_types: Dict[str, int] = {}
+                if holders:
+                    ref_types["LOCAL_REFERENCE"] = len(holders)
+                if task_pins.get(oid):
+                    ref_types["USED_BY_PENDING_TASK"] = task_pins[oid]
+                if contained.get(oid):
+                    ref_types["CAPTURED_IN_OBJECT"] = contained[oid]
+                if oid in actor_returns:
+                    ref_types["ACTOR_HANDLE"] = 1
+                rows.append({
+                    "object_id": oid,
+                    "node_id": loc[0] if loc is not None else None,
+                    "size": loc[1].size if loc is not None else None,
+                    "callsite": prov[0] if prov else None,
+                    "creator": prov[1] if prov else None,
+                    "ref_types": ref_types,
+                    "pins": self.ref_pins.get(oid, 0),
+                    "leaked": oid in self._leaks,
+                })
+            return {"objects": rows,
+                    "leaks": [dict(r) for r in self._leaks.values()]}
+
+    def sweep_object_leaks(self):
+        """Rate-limited leak sweep: flag objects whose EVERY ref holder
+        lives on a dead node (the node died before its processes could
+        drop their refs — nothing will ever free them), and objects
+        that sat pinned with zero holders past
+        ``memory_leak_pinned_ttl_s`` (a task pin / contained pin whose
+        release path is wedged). Returns ``(new_records, total)`` —
+        ``new_records`` are findings not yet warned about (the caller
+        emits them as OBJECT_LEAK WARNING events), ``total`` the
+        current finding count for the gauge; ``([], None)`` when
+        rate-limited or disabled."""
+        interval = CONFIG.memory_leak_sweep_interval_s
+        # interval<=0 disables leak FINDING only: the bookkeeping GC
+        # below (parked containments, dead provenance entries) must
+        # still run or a long-lived head grows without bound
+        gc_only = interval <= 0
+        period = interval if interval > 0 else 30.0
+        now = time.time()
+        out: List[dict] = []
+        with self._lock:
+            if now - self._leak_last_sweep < period:
+                return [], None
+            self._leak_last_sweep = now
+            alive = {n.node_id.binary() for n in self.nodes.values()
+                     if n.alive}
+            ttl = CONFIG.memory_leak_pinned_ttl_s
+            leaks: Dict[ObjectID, dict] = {}
+            for oid, holders in (() if gc_only
+                                 else self.ref_holders.items()):
+                cause = None
+                age = None
+                if holders:
+                    # holder = (node_id_binary, conn_key): a holder on
+                    # a live node is (or will be) cleaned by that
+                    # node's conn-close path; one on a dead node never
+                    if all(h[0] not in alive for h in holders):
+                        cause = "dead_holders"
+                    self._pinned_zero_since.pop(oid, None)
+                elif self.ref_pins.get(oid, 0) > 0:
+                    since = self._pinned_zero_since.setdefault(oid, now)
+                    age = now - since
+                    if ttl > 0 and age >= ttl:
+                        cause = "pinned_no_holder"
+                if cause is None:
+                    continue
+                loc = self.directory.get(oid)
+                prov = self.obj_provenance.get(oid)
+                rec = {"object_id": oid, "cause": cause,
+                       "node_id": loc[0] if loc is not None else None,
+                       "size": loc[1].size if loc is not None else None,
+                       "callsite": prov[0] if prov else None,
+                       "creator": prov[1] if prov else None,
+                       "holders": len(holders),
+                       "pins": self.ref_pins.get(oid, 0)}
+                if age is not None:
+                    rec["age_s"] = round(age, 1)
+                leaks[oid] = rec
+                if self._leak_warned.get(oid) != cause:
+                    self._leak_warned[oid] = cause
+                    out.append(dict(rec))
+            self._leaks = leaks
+            # GC sweep state + provenance for objects that are fully
+            # gone (freed, or never tracked at all)
+            for d in (self._leak_warned, self._pinned_zero_since):
+                for oid in [o for o in d if o not in self.ref_holders]:
+                    del d[oid]
+            for oid in [o for o in self.obj_provenance
+                        if o not in self.ref_holders
+                        and o not in self.directory
+                        and not self.ref_pins.get(o)]:
+                del self.obj_provenance[oid]
+            # parked containments whose holder never registered
+            # (fire-and-forget returns): drop after a generous TTL
+            cutoff = now - 30.0
+            for oid in [o for o, (_c, t) in
+                        self._contained_pending.items() if t < cutoff]:
+                del self._contained_pending[oid]
+            return out, len(leaks)
 
     # --------------------------------------------------------------- lineage
     @staticmethod

@@ -40,6 +40,7 @@ _ALLOWED = frozenset({
     "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
     "unpin_task_args", "pin_contained", "record_lineage", "get_lineage",
     "claim_lineage",
+    "record_provenance", "objects_info", "memory_state",
     "record_cluster_event", "list_cluster_events",
     "record_spans", "list_spans", "record_metrics", "metrics_snapshot",
     "claim_actor_reroute",
@@ -204,6 +205,7 @@ class RemoteControlPlane:
         "record_task_event", "publish", "kv_del", "finish_job",
         "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
         "unpin_task_args", "pin_contained", "record_lineage",
+        "record_provenance",
         "record_cluster_event", "record_spans", "record_metrics",
         "gen_update", "gen_done", "gen_consumed", "gen_drop",
         "register_pending_pg", "clear_pending_pg",

@@ -107,16 +107,20 @@ class MemoryMonitor:
 
 
 def pick_oom_victim(workers: Iterable,
-                    actor_restartable=lambda actor_id: False
+                    actor_restartable=lambda actor_id: False,
+                    rss_of=lambda worker: 0,
                     ) -> Optional[object]:
     """Choose the worker to kill under memory pressure.
 
     Policy (reference ``worker_killing_policy.h:34`` RetriableLIFO):
     prefer workers whose in-flight work can be retried/restarted
-    (retriable tasks first, then restartable actors), and among equals
-    kill the most recently started — the oldest work has the most sunk
-    cost. Idle/starting workers are not considered (they hold no task
-    to shed; idle eviction handles them separately).
+    (retriable tasks first, then restartable actors); among equals kill
+    the largest resident set (``rss_of``, the kill that actually
+    relieves the pressure), and only then the most recently started —
+    the oldest work has the most sunk cost. ``rss_of`` defaults to a
+    constant so callers without pid access keep pure retriable-LIFO.
+    Idle/starting workers are not considered (they hold no task to
+    shed; idle eviction handles them separately).
     """
     best = None
     best_key = None
@@ -135,10 +139,11 @@ def pick_oom_victim(workers: Iterable,
             retriable = 2 if (rec.retries_left > 0
                               or getattr(rec, "oom_retries_left", 0) > 0
                               ) else 0
-        # newest *assignment* wins (pooled workers are reused, so process
-        # start time would misrank sunk cost); fall back to process start
-        # for workers that predate assignment stamping
-        key = (retriable, getattr(w, "assigned_at", 0.0) or w.started_at)
+        # newest *assignment* as the last tiebreak (pooled workers are
+        # reused, so process start time would misrank sunk cost); fall
+        # back to process start for workers predating assignment stamps
+        key = (retriable, rss_of(w),
+               getattr(w, "assigned_at", 0.0) or w.started_at)
         if best_key is None or key > best_key:
             best, best_key = w, key
     return best

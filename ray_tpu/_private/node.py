@@ -894,6 +894,7 @@ class NodeService:
         self._retry_infeasible()
         self._spill_starved_pending()
         self._sweep_stalls()
+        self._sweep_object_leaks()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
         self._dispatch()
@@ -915,6 +916,39 @@ class NodeService:
         for rec in stalls:
             self.events.warning("TASK_STALL",
                                 rec.pop("message", "task stalled"), **rec)
+
+    def _sweep_object_leaks(self) -> None:
+        """Trigger the control plane's object-leak sweep (same
+        plane-hosting-node rule as ``_sweep_stalls``; the plane
+        self-rate-limits). New findings become OBJECT_LEAK WARNING
+        events carrying the creation callsite; the current finding
+        count feeds the ``rtpu_object_leaked_objects`` gauge."""
+        if not isinstance(self.gcs, GlobalControlPlane):
+            return
+        try:
+            new, total = self.gcs.sweep_object_leaks()
+        except Exception:   # noqa: BLE001 — diagnosis must not kill ticks
+            return
+        if total is not None:
+            telemetry.gauge_set(telemetry.M_OBJ_LEAKED, float(total),
+                                self._mtags)
+        for rec in new:
+            oid = rec.pop("object_id")
+            # the object's LOCATION rides under its own key: **rec would
+            # otherwise clobber EventLogger's standard node_id field
+            # (the emitting node's hex) with a raw NodeID/None
+            loc = rec.pop("node_id", None)
+            where = (f" created at {rec['callsite']}" if rec.get("callsite")
+                     else "")
+            why = ("every ref holder lives on a dead node"
+                   if rec.get("cause") == "dead_holders" else
+                   f"pinned with zero holders for {rec.get('age_s', '?')}s")
+            self.events.warning(
+                "OBJECT_LEAK",
+                f"object {oid.hex()[:12]}{where} looks leaked: {why}",
+                object_id=oid.hex(),
+                object_node_id=(loc.hex() if loc is not None else None),
+                **rec)
 
     def _coll_stall_probe(self, candidates: List[tuple]) -> List[tuple]:
         """``collective_stuck`` half of the stall sweep (runs on the
@@ -994,7 +1028,11 @@ class NodeService:
             # restarts_left == -1 means restart forever (same contract as
             # the restart path below): that actor is maximally retriable
             actor_restartable=lambda aid: (
-                (self._actors.get(aid) or {}).get("restarts_left", 0) != 0))
+                (self._actors.get(aid) or {}).get("restarts_left", 0) != 0),
+            # among equally-retriable candidates kill the biggest RSS:
+            # that is the kill that actually relieves the pressure
+            rss_of=lambda w: memory_monitor.process_rss_bytes(
+                w.proc.pid if w.proc is not None else (w.pid or -1)))
         if victim is None:
             return
         pid = victim.proc.pid if victim.proc is not None else victim.pid
@@ -1005,15 +1043,29 @@ class NodeService:
             return
         victim.oom_victim = True
         snap = self._memory_monitor.snapshot()
+        rss = memory_monitor.process_rss_bytes(pid)
+        top = self._oom_autopsy(victim)
         print(f"[rtpu] node {self.node_id.hex()[:8]}: memory usage "
               f"{frac:.0%} >= threshold "
               f"{CONFIG.memory_usage_threshold:.0%}; killing worker "
               f"pid={pid} ({snap['available_bytes']>>20} MiB avail)",
               file=sys.stderr)
+        # autopsy in the event itself: the victim's RSS plus the top
+        # objects it owned/held, each with its creation callsite — the
+        # kill names its probable cause instead of a bare OOM_KILL
+        message = ("memory monitor killed a worker to relieve node "
+                   f"memory pressure (victim rss {rss >> 20} MiB)")
+        if top:
+            t0 = top[0]
+            where = (f", created at {t0['callsite']}" if t0.get("callsite")
+                     else "")
+            message += (f"; top held object {t0['object_id'][:12]} "
+                        f"({t0.get('size') or '?'} B{where})")
         self.events.warning(
-            "OOM_KILL", "memory monitor killed a worker to relieve "
-            "node memory pressure", pid=pid,
+            "OOM_KILL", message, pid=pid,
             usage_fraction=round(frac, 3),
+            rss_bytes=rss,
+            top_objects=top,
             task=(victim.task.spec.name if victim.task else None),
             actor_id=(victim.actor_id.hex() if victim.actor_id else None))
         try:
@@ -1023,6 +1075,38 @@ class NodeService:
                 os.kill(pid, signal.SIGKILL)
         except OSError:
             pass
+
+    def _oom_autopsy(self, victim) -> List[dict]:
+        """Top objects the OOM victim owned/held: refs registered on its
+        connection plus the resolved args of its running/pipelined
+        tasks, sized and attributed through the control plane in one
+        ``objects_info`` batch. Best-effort and bounded — the kill must
+        not wait on a slow plane."""
+        oids: List[ObjectID] = []
+        seen = set()
+        if victim.conn_key is not None:
+            for oid in list(self._conn_refs.get(victim.conn_key) or ()):
+                if oid not in seen:
+                    seen.add(oid)
+                    oids.append(oid)
+        for rec in (([victim.task] if victim.task is not None else [])
+                    + list(victim.pipeline)):
+            for oid in rec.deps:
+                if oid not in seen:
+                    seen.add(oid)
+                    oids.append(oid)
+        if not oids:
+            return []
+        try:
+            info = self.gcs.objects_info(oids[:64])
+        except Exception:   # noqa: BLE001 — autopsy is best-effort
+            return []
+        rows = sorted(info.values(),
+                      key=lambda r: -(r.get("size") or 0))[:5]
+        return [{"object_id": r["object_id"].hex(),
+                 "size": r.get("size"),
+                 "callsite": r.get("callsite"),
+                 "creator": r.get("creator")} for r in rows]
 
     def _park_infeasible(self, kind: str, spec) -> bool:
         """Queue work with no feasible node while the autoscaler adds
@@ -1310,6 +1394,10 @@ class NodeService:
             return []
         if what == "memory":
             return self._memory_monitor.snapshot()
+        if what == "objects":
+            # per-object (pinned, spilled) from THIS node's store — the
+            # node-local half of the memory introspection plane
+            return self.store.objects_snapshot()
         return None
 
     # -------------------------------------------- debugging & profiling
@@ -1875,6 +1963,11 @@ class NodeService:
             try:
                 self.gcs.pin_contained(holder_oid, contained)
             except Exception:   # noqa: BLE001 — best-effort, like edges
+                pass
+        elif op == P.OBJ_PROVENANCE:
+            try:
+                self.gcs.record_provenance(payload)
+            except Exception:   # noqa: BLE001 — attribution is best-effort
                 pass
 
     def _reply(self, conn_key: int, op: int, payload: Any) -> None:
@@ -4067,8 +4160,17 @@ class NodeService:
                      "num_restarts": rec.num_restarts}
                     for aid, rec in self.gcs.actors_snapshot()]
         if what == "objects":
-            return [{"object_id": oid, "node_id": nid, "size": meta.size}
-                    for oid, (nid, meta) in self.gcs.directory_snapshot()]
+            return self._memory_objects()
+        if what == "memory":
+            # full introspection payload: enriched object rows + current
+            # leak findings + per-node store stats
+            rows, leaks = self._memory_objects(with_leaks=True)
+            stores = {}
+            for info in self.gcs.alive_nodes():
+                st = self._peer_stats(info, "store")
+                if st:
+                    stores[info.node_id.hex()] = st
+            return {"objects": rows, "leaks": leaks, "stores": stores}
         if what == "placement_groups":
             return [{"pg_id": pid, "state": rec["state"],
                      "bundles": rec["spec"].bundles,
@@ -4091,6 +4193,28 @@ class NodeService:
             telemetry.flush()
             return self.gcs.metrics_snapshot()
         return None
+
+    def _memory_objects(self, with_leaks: bool = False):
+        """Enriched object ledger rows: the control plane's consistent
+        snapshot (size, callsite, creator, ref types) merged with each
+        node's store-local pin/spill facts (one ``node_stats`` fan-out
+        per query — an introspection surface, never a hot path)."""
+        mem = self.gcs.memory_state() or {}
+        rows = mem.get("objects") or []
+        local: Dict[Any, tuple] = {}
+        for info in self.gcs.alive_nodes():
+            snap = self._peer_stats(info, "objects")
+            if snap:
+                local.update(snap)
+        for row in rows:
+            pinned, spilled = local.get(row["object_id"], (0, False))
+            row["pinned_in_store"] = pinned
+            row["spilled"] = spilled
+            if pinned:
+                row["ref_types"]["PINNED_IN_STORE"] = pinned
+        if with_leaks:
+            return rows, mem.get("leaks") or []
+        return rows
 
     def _record_event(self, spec: P.TaskSpec, state: str,
                       pending_args: Optional[List[ObjectID]] = None) -> None:

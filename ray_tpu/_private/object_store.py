@@ -646,6 +646,15 @@ class ObjectStore:
                 return existing
         return meta
 
+    def objects_snapshot(self) -> Dict[ObjectID, tuple]:
+        """Per-object introspection view: ``oid -> (pinned_count,
+        spilled)`` for every sealed entry. Feeds the PINNED_IN_STORE /
+        spilled columns of ``state.list_objects()`` (pin counts are
+        node-local store facts the control-plane ledger can't know)."""
+        with self._lock:
+            return {oid: (e.pinned, e.spilled_path is not None)
+                    for oid, e in self._entries.items() if e.sealed}
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             out = {

@@ -165,6 +165,15 @@ COLL_PROGRESS_REPLY = 78  # worker/driver -> node: (token, snapshot dict)
 CLUSTER_COLL = 79       # any client -> node: (req_id, what, timeout_s)
                         # what = "health" | "records" -> INFO_REPLY dict
 
+# Object ownership/provenance plane (reference analogue: the
+# ReferenceCounter's per-ref creation callsites behind
+# RAY_record_ref_creation_sites, surfaced by `ray memory`). Clients
+# buffer (oid, callsite, creator) records per put()/.remote() and flush
+# them alongside the ref-edge stream; the node applies them to the
+# control-plane provenance table so every object in the ledger knows
+# who made it and from where.
+OBJ_PROVENANCE = 80     # [(ObjectID, callsite, creator), ...]
+
 # Generic coalesced frame: (BATCH, [(op, payload), ...]). Produced by
 # the Connection writer when several messages are pending at flush time
 # — ONE pickle stream + one frame + one receiver wakeup for the burst —

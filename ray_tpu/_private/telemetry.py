@@ -414,6 +414,15 @@ M_STORE_OBJECTS = define(
 M_STORE_SPILLED = define(
     "gauge", "rtpu_object_store_spilled_objects",
     "Objects spilled to disk since node start (sampled)")
+M_OBJ_CALLSITES = define(
+    "counter", "rtpu_object_callsites_recorded_total",
+    "Creation callsites captured for puts / task returns / actor "
+    "creations (object_callsite_enabled provenance plane)")
+M_OBJ_LEAKED = define(
+    "gauge", "rtpu_object_leaked_objects",
+    "Objects the control-plane leak sweep currently flags: every ref "
+    "holder lives on a dead node, or pinned with zero holders past "
+    "memory_leak_pinned_ttl_s")
 M_GCS_RPC_LATENCY = define(
     "histogram", "rtpu_gcs_rpc_latency_seconds",
     "Round-trip latency of synchronous control-plane RPCs, tagged by "

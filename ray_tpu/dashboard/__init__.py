@@ -243,6 +243,17 @@ class _Handler(JsonHandler):
             if path == "/api/objects":
                 return self._json(200, {"objects": state_api.shape_objects(
                     node._state_query("objects", None))})
+            if path == "/api/memory":
+                # memory introspection plane: per-object provenance +
+                # ref types, grouped callsite rollup, leak findings
+                mem = node._state_query("memory", None) or {}
+                rows = state_api.shape_objects(mem.get("objects"))
+                return self._json(200, {
+                    "summary": state_api.summarize_memory_rows(rows),
+                    "objects": rows[:200],
+                    "leaks": state_api.shape_leaks(mem.get("leaks")),
+                    "stores": mem.get("stores") or {},
+                })
             if path == "/api/placement_groups":
                 return self._json(200, {
                     "placement_groups": state_api.shape_placement_groups(

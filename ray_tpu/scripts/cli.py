@@ -91,7 +91,7 @@ def cmd_list(client, args) -> None:
         cols = ["actor_id", "class_name", "name", "state", "num_restarts"]
     elif what == "objects":
         rows = list_objects(limit=args.limit)
-        cols = ["object_id", "node_id", "size"]
+        cols = ["object_id", "node_id", "size", "callsite", "creator"]
     elif what in ("pgs", "placement_groups"):
         rows = list_placement_groups(limit=args.limit)
         cols = ["pg_id", "strategy", "bundles"]
@@ -132,10 +132,73 @@ def cmd_metrics(client, args) -> None:
         print(export_prometheus(), end="")
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def _fmt_ref_types(rt: dict) -> str:
+    return ",".join(f"{k}:{v}" for k, v in sorted((rt or {}).items())) \
+        or "-"
+
+
 def cmd_memory(client, args) -> None:
-    stats = client.cluster_info("store_stats") or {}
-    for k, v in sorted(stats.items()):
-        print(f"{k}: {v}")
+    """Object ownership & memory introspection (reference: ``ray
+    memory``): grouped byte totals by creation callsite / creator /
+    node, per-object rows with ref types, leak findings, and per-node
+    store stats."""
+    from ..state import list_objects, memory_summary
+    summary = memory_summary(group_by=args.group_by, top_k=args.limit,
+                             sort_by=args.sort_by)
+    objects = None
+    if args.objects:
+        objects = list_objects(limit=10**9)
+        objects.sort(key=lambda r: -(r.get("size") or 0))
+        objects = objects[:args.limit]
+    if args.format == "json":
+        if objects is not None:
+            summary = {**summary, "objects": objects}
+        print(json.dumps(summary, default=str, indent=2))
+        return
+    print(f"{summary['total_objects']} tracked object(s), "
+          f"{_fmt_bytes(summary['total_bytes'])} cluster-wide")
+    for node_hex, st in sorted((summary.get("stores") or {}).items()):
+        print(f"  store {node_hex[:12]}: "
+              f"{_fmt_bytes(st.get('used_bytes'))} / "
+              f"{_fmt_bytes(st.get('capacity_bytes'))} used, "
+              f"{st.get('num_objects', 0)} object(s), "
+              f"{st.get('num_spilled', 0)} spilled")
+    order = ("most objects" if args.sort_by == "count"
+             else "most bytes")
+    print(f"\nBy {args.group_by} (top {args.limit}, {order} first):")
+    _print_table(
+        [{args.group_by: g["key"], "objects": g["objects"],
+          "bytes": _fmt_bytes(g["bytes"]),
+          "ref_types": _fmt_ref_types(g["ref_types"])}
+         for g in summary["groups"]],
+        [args.group_by, "objects", "bytes", "ref_types"])
+    if summary.get("dropped_groups"):
+        print(f"  (+{summary['dropped_groups']} more group(s); raise "
+              "--limit)")
+    if objects is not None:
+        print("\nObjects (largest first):")
+        _print_table(
+            [{**o, "size": _fmt_bytes(o.get("size")),
+              "ref_types": _fmt_ref_types(o.get("ref_types"))}
+             for o in objects],
+            ["object_id", "size", "callsite", "creator", "ref_types",
+             "pinned_in_store", "spilled"])
+    for leak in summary.get("leaks") or []:
+        print(f"  ! LEAK [{leak.get('cause')}] object "
+              f"{str(leak.get('object_id'))[:12]} "
+              f"size={_fmt_bytes(leak.get('size'))} "
+              f"callsite={leak.get('callsite')}")
 
 
 def cmd_timeline(client, args) -> None:
@@ -424,7 +487,20 @@ def main(argv=None) -> None:
                            help="runtime metrics (Prometheus or summary)")
     p_met.add_argument("--format", choices=("prom", "summary"),
                        default="prom")
-    sub.add_parser("memory")
+    p_mem = sub.add_parser("memory",
+                           help="object ownership & memory "
+                           "introspection (ray memory)")
+    p_mem.add_argument("--group-by",
+                       choices=("callsite", "creator", "node"),
+                       default="callsite")
+    p_mem.add_argument("--sort-by", choices=("bytes", "count"),
+                       default="bytes",
+                       help="group ordering: byte total or object count")
+    p_mem.add_argument("--objects", action="store_true",
+                       help="also print per-object rows")
+    p_mem.add_argument("--limit", type=int, default=20)
+    p_mem.add_argument("--format", choices=("table", "json"),
+                       default="table")
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("-o", "--output")
     p_stack = sub.add_parser("stack",

@@ -12,6 +12,7 @@ from .api import (  # noqa: F401
     flight_records,
     health_report,
     list_actors,
+    list_cluster_events,
     list_jobs,
     list_metrics,
     list_nodes,
@@ -19,6 +20,7 @@ from .api import (  # noqa: F401
     list_placement_groups,
     list_tasks,
     list_workers,
+    memory_summary,
     profile,
     summarize_actors,
     summarize_metrics,

@@ -60,11 +60,73 @@ def shape_actors(recs: List[dict]) -> List[dict]:
 
 
 def shape_objects(recs: List[dict]) -> List[dict]:
+    """Tolerant of records missing optional keys (a ledger row for an
+    object that is held but not yet sealed has no node/size; pre-PR
+    minimal records shape fine too)."""
     return [{
-        "object_id": _hex(rec["object_id"]),
-        "node_id": _hex(rec["node_id"]),
-        "size": rec["size"],
+        "object_id": _hex(rec.get("object_id")),
+        "node_id": (_hex(rec["node_id"])
+                    if rec.get("node_id") is not None else None),
+        "size": rec.get("size"),
+        "callsite": rec.get("callsite"),
+        "creator": rec.get("creator"),
+        "ref_types": dict(rec.get("ref_types") or {}),
+        "pins": rec.get("pins", 0),
+        "pinned_in_store": rec.get("pinned_in_store", 0),
+        "spilled": rec.get("spilled", False),
+        "leaked": rec.get("leaked", False),
     } for rec in recs or []]
+
+
+def shape_leaks(recs: List[dict]) -> List[dict]:
+    return [{
+        **rec,
+        "object_id": _hex(rec.get("object_id")),
+        "node_id": (_hex(rec["node_id"])
+                    if rec.get("node_id") is not None else None),
+    } for rec in recs or []]
+
+
+def summarize_memory_rows(rows: List[dict], group_by: str = "callsite",
+                          top_k: int = 20,
+                          sort_by: str = "bytes") -> Dict[str, Any]:
+    """Group shaped object rows by creation callsite / creator / node
+    with byte+count totals and a merged ref-type breakdown, largest
+    group first by ``sort_by`` (``bytes`` | ``count`` — applied BEFORE
+    the top-K cut, so the #1 group by the chosen key is always shown).
+    The ``ray memory --group-by`` rollup, shared by
+    ``memory_summary()``, the dashboard ``/api/memory`` endpoint and
+    ``rtpu memory``."""
+    key_field = "node_id" if group_by == "node" else group_by
+    if key_field not in ("callsite", "creator", "node_id"):
+        raise ValueError(f"unknown group_by {group_by!r} "
+                         "(callsite | creator | node)")
+    if sort_by not in ("bytes", "count"):
+        raise ValueError(f"unknown sort_by {sort_by!r} (bytes | count)")
+    groups: Dict[str, dict] = {}
+    total_bytes = 0
+    for r in rows:
+        size = r.get("size") or 0
+        total_bytes += size
+        key = str(r.get(key_field) or "<unknown>")
+        g = groups.setdefault(key, {"key": key, "objects": 0,
+                                    "bytes": 0, "ref_types": {}})
+        g["objects"] += 1
+        g["bytes"] += size
+        for t, n in (r.get("ref_types") or {}).items():
+            g["ref_types"][t] = g["ref_types"].get(t, 0) + n
+    sort_key = ((lambda g: (-g["objects"], -g["bytes"], g["key"]))
+                if sort_by == "count" else
+                (lambda g: (-g["bytes"], -g["objects"], g["key"])))
+    ordered = sorted(groups.values(), key=sort_key)
+    return {
+        "group_by": group_by,
+        "sort_by": sort_by,
+        "total_objects": len(rows),
+        "total_bytes": total_bytes,
+        "groups": ordered[:top_k],
+        "dropped_groups": max(0, len(ordered) - top_k),
+    }
 
 
 def shape_placement_groups(recs: List[dict]) -> List[dict]:
@@ -211,6 +273,24 @@ def summarize_metrics() -> Dict[str, Any]:
     return out
 
 
+def memory_summary(group_by: str = "callsite", top_k: int = 20,
+                   sort_by: str = "bytes") -> Dict[str, Any]:
+    """Cluster-wide object-memory rollup (reference: ``ray memory`` /
+    memory summary): every object the control plane tracks — with its
+    creation callsite, creator task/actor and reference types
+    (LOCAL_REFERENCE / USED_BY_PENDING_TASK / CAPTURED_IN_OBJECT /
+    ACTOR_HANDLE / PINNED_IN_STORE) — grouped by ``group_by``
+    (``callsite`` | ``creator`` | ``node``) with byte totals, plus the
+    current leak findings and per-node store stats."""
+    mem = _query("memory") or {}
+    rows = shape_objects(mem.get("objects"))
+    out = summarize_memory_rows(rows, group_by=group_by, top_k=top_k,
+                                sort_by=sort_by)
+    out["leaks"] = shape_leaks(mem.get("leaks"))
+    out["stores"] = mem.get("stores") or {}
+    return out
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Count by (name, state) — reference: ``ray summary tasks``."""
     return summarize_task_rows(list_tasks(limit=10**9))
@@ -311,6 +391,12 @@ def health_report() -> Dict[str, Any]:
     except Exception:   # noqa: BLE001 — doctor degrades, never dies
         coll = {}
     coll_verdicts = coll.get("verdicts") or []
+    try:
+        mem = _query("memory") or {}
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        mem = {}
+    mem_rows = shape_objects(mem.get("objects"))
+    leaks = shape_leaks(mem.get("leaks"))
 
     highlights: Dict[str, Any] = {}
     try:
@@ -348,6 +434,12 @@ def health_report() -> Dict[str, Any]:
     if coll_verdicts:
         problems.append(f"{len(coll_verdicts)} stuck collective op(s) "
                         "— see collectives")
+    if leaks:
+        named = next((lk for lk in leaks if lk.get("callsite")), None)
+        where = (f" — e.g. object created at {named['callsite']}"
+                 if named else "")
+        problems.append(f"{len(leaks)} leaked object(s){where} "
+                        "— see memory")
     return {
         "healthy": not problems,
         "problems": problems,
@@ -360,6 +452,10 @@ def health_report() -> Dict[str, Any]:
         "alerts": alerts[-20:],
         "collectives": {"ops": coll.get("ops") or [],
                         "verdicts": coll_verdicts},
+        "memory": {"objects": len(mem_rows),
+                   "bytes": sum(r.get("size") or 0 for r in mem_rows),
+                   "leaked": len(leaks),
+                   "leaks": leaks[:10]},
         "metrics": highlights,
     }
 

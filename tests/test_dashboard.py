@@ -79,6 +79,27 @@ def test_nodes_objects_pgs_workers(dashboard):
     assert len(workers) >= 1
 
 
+def test_memory_endpoint(dashboard):
+    import time
+
+    import numpy as np
+
+    from ray_tpu import state as rstate  # noqa: F401 — surfaces loaded
+
+    big = ray_tpu.put(np.zeros(120_000, dtype=np.uint8))  # noqa: F841
+    time.sleep(0.2)                       # provenance flush cadence
+    data = _fetch_json(dashboard.port, "/api/memory")
+    assert data["summary"]["total_objects"] >= 1
+    assert data["summary"]["total_bytes"] >= 120_000
+    assert data["leaks"] == []
+    assert data["stores"]
+    rows = data["objects"]
+    mine = [r for r in rows
+            if "test_dashboard.py" in (r.get("callsite") or "")]
+    assert mine, rows
+    assert mine[0]["ref_types"].get("LOCAL_REFERENCE", 0) >= 1
+
+
 def test_html_page_and_404(dashboard):
     status, body = _fetch(dashboard.port, "/")
     assert status == 200 and b"ray_tpu dashboard" in body

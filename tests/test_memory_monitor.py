@@ -119,6 +119,74 @@ def test_victim_policy_retriable_lifo():
     assert pick_oom_victim([idle]) is None
 
 
+def test_victim_policy_largest_rss_among_equals():
+    """ISSUE 11: among equally-retriable candidates the largest RSS
+    dies (the kill that actually relieves pressure); recency is only
+    the final tiebreak, and retriability still dominates RSS."""
+    newest_small = _FakeWorker(task=_FakeRec(retries_left=1),
+                               started_at=9.0)
+    oldest_fat = _FakeWorker(task=_FakeRec(retries_left=1),
+                             started_at=1.0)
+    rss = {id(newest_small): 10 << 20, id(oldest_fat): 900 << 20}
+    victim = pick_oom_victim([newest_small, oldest_fat],
+                             rss_of=lambda w: rss[id(w)])
+    assert victim is oldest_fat
+    # retriable-first still outranks a fatter non-retriable worker
+    fat_dead_end = _FakeWorker(task=_FakeRec(), started_at=5.0)
+    rss2 = {id(newest_small): 1 << 20, id(fat_dead_end): 4 << 30}
+    victim = pick_oom_victim([newest_small, fat_dead_end],
+                             rss_of=lambda w: rss2[id(w)])
+    assert victim is newest_small
+    # equal RSS: newest assignment goes (the RetriableLIFO tiebreak)
+    victim = pick_oom_victim([newest_small, oldest_fat],
+                             rss_of=lambda w: 0)
+    assert victim is newest_small
+
+
+def test_oom_autopsy_names_victims_top_object(tmp_path, pressure_env):
+    """ISSUE 11 acceptance: an induced OOM kill produces an OOM_KILL
+    event carrying the victim's RSS and naming its top held object and
+    that object's creation callsite."""
+    import numpy as np
+
+    from ray_tpu import state as rstate
+
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"memory_monitor_refresh_ms": 100,
+                                 "task_oom_retries_default": 0})
+    try:
+        big = ray_tpu.put(np.zeros(300_000, dtype=np.uint8))  # BIG_LINE
+
+        @ray_tpu.remote
+        def hold_and_sleep(boxed, marker):
+            with open(marker, "w") as f:
+                f.write("running")
+            time.sleep(60)
+
+        marker = str(tmp_path / "running.txt")
+        # nested so the worker HOLDS a live ref (top-level args resolve
+        # to values); the dep pin names it through rec.deps either way
+        ref = hold_and_sleep.options(max_retries=0).remote([big], marker)
+        assert _wait_for_attempts(marker, 1)
+        os.environ["RTPU_TEST_MEMORY_USAGE_FRACTION"] = "0.99"
+        with pytest.raises(OutOfMemoryError):
+            ray_tpu.get(ref, timeout=30)
+        events = rstate.list_cluster_events(filters={"label": "OOM_KILL"})
+        assert events, "no OOM_KILL event recorded"
+        ev = events[-1]
+        assert ev.get("rss_bytes", 0) > 0
+        tops = ev.get("top_objects") or []
+        assert tops, ev
+        assert tops[0]["size"] >= 300_000
+        assert tops[0]["object_id"] == big.id.hex()
+        assert "test_memory_monitor.py" in (tops[0].get("callsite") or "")
+        # the event MESSAGE itself names the object and its callsite
+        assert big.id.hex()[:12] in ev["message"]
+        assert "test_memory_monitor.py" in ev["message"]
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_victim_policy_prefers_tasks_over_actors():
     actor = _FakeWorker(state="ACTOR", actor_id="a1", started_at=9.0)
     task = _FakeWorker(task=_FakeRec(retries_left=1), started_at=1.0)

@@ -162,14 +162,26 @@ def test_cli_subprocess(rtpu_init):
         return x
 
     ray_tpu.get([job.remote(i) for i in range(2)])
+    import numpy as np
+    big = ray_tpu.put(np.zeros(150_000, dtype=np.uint8))  # noqa: F841
+    time.sleep(0.2)                       # provenance flush cadence
     session = ray_tpu._session_dir
     for argv in (["status"], ["list", "tasks"], ["summary", "tasks"],
-                 ["memory"]):
+                 ["memory"], ["memory", "--group-by", "creator",
+                              "--sort-by", "count", "--objects"],
+                 ["memory", "--format", "json"]):
         out = subprocess.run(
             [sys.executable, "-m", "ray_tpu.scripts.cli",
              "--session", session] + argv,
             capture_output=True, text=True, timeout=60)
-        assert out.returncode == 0, out.stderr
+        assert out.returncode == 0, (argv, out.stderr)
+    memory = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "--session",
+         session, "memory", "--objects"],
+        capture_output=True, text=True, timeout=60)
+    # grouped rollup names the put's callsite, objects table the ref type
+    assert "test_state_cli.py" in memory.stdout, memory.stdout
+    assert "LOCAL_REFERENCE" in memory.stdout, memory.stdout
     status = subprocess.run(
         [sys.executable, "-m", "ray_tpu.scripts.cli", "--session",
          session, "status"], capture_output=True, text=True, timeout=60)
