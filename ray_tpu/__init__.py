@@ -292,6 +292,23 @@ def exit_actor() -> None:
     raise SystemExit(0)
 
 
+def actor_checkpoint() -> int:
+    """Snapshot the CURRENT actor's state now, from inside one of its
+    methods: calls the actor's opt-in ``save_checkpoint()`` and
+    persists the result in the control plane (synchronously — when this
+    returns, a restart restores at least this state). A restarted actor
+    whose class defines ``restore_checkpoint(state)`` replays its
+    latest snapshot before any queued call drains. Returns the
+    checkpoint's sequence number. See also the periodic trigger,
+    ``actor_checkpoint_interval_calls``."""
+    hook = _ctx.actor_checkpoint_hook
+    if hook is None or _ctx.current_actor_id is None:
+        raise RuntimeError(
+            "actor_checkpoint() can only be called inside a method of "
+            "an actor that defines save_checkpoint()")
+    return hook()
+
+
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     """Cancel the task that produces ``ref`` (reference: ``ray.cancel``)."""
     _ctx.require_client().cancel_task(ref.task_id(), force)

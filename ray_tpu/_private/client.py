@@ -888,6 +888,22 @@ class CoreClient:
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
         self._send(P.KILL_ACTOR, (actor_id, no_restart))
 
+    def save_actor_checkpoint(self, actor_id: ActorID, seq: int,
+                              blob: bytes) -> bool:
+        """Persist one actor-state snapshot in the control plane.
+        SYNCHRONOUS on purpose: the worker checkpoints before reporting
+        the triggering call done, so a completion the caller observed
+        is never ahead of the state a restart would restore. Large
+        blobs ride out-of-band (zero-copy iovec)."""
+        return self._request(
+            P.ACTOR_CHECKPOINT,
+            lambda rid: (rid, actor_id, seq, P.oob_wrap(blob))).result()
+
+    def get_actor_checkpoint(self, actor_id: ActorID):
+        """(seq, blob) of the actor's latest checkpoint, or None."""
+        return self._request(
+            P.ACTOR_CHECKPOINT_GET, lambda rid: (rid, actor_id)).result()
+
     def actor_exit(self, actor_id: ActorID, reason: str) -> None:
         """Worker-side intentional exit of its own actor (the send half
         of ``ray_tpu.exit_actor()``)."""

@@ -14,11 +14,21 @@ Completion is driven by connection reader threads: an arriving
 variable that wakes the rank thread blocked in ``wait``. There is no
 polling anywhere on this path — a waiter sleeps until its chunk arrives
 or its deadline passes.
+
+**Epoch fencing** (group self-healing): when a collective group reforms
+after a rank death, the failing epoch is ``fence``d *before* the
+survivors re-join — its undelivered chunks are dropped on the spot, and
+any chunk of that epoch still in flight (a dead rank's last sends, a
+survivor's pipelined traffic) is refused at ``deposit`` time instead of
+parked. A stale-epoch chunk can therefore never be delivered into (or
+accumulate beside) the reformed epoch's calls, and teardown never waits
+on the TTL sweep.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from . import context
@@ -43,6 +53,11 @@ M_COLL_HOP = telemetry.define(
     "Time a rank thread spent blocked waiting for one collective chunk "
     "to arrive, tagged by schedule phase — per-rank hop-latency skew "
     "makes chronic stragglers visible before they become hangs")
+M_COLL_FENCED = telemetry.define(
+    "counter", "rtpu_collective_fenced_chunks_total",
+    "Stale-epoch collective chunks dropped by the reform fence (swept "
+    "from the mailbox at fence time, or refused on arrival) — traffic "
+    "of a failed epoch that must never reach the reformed group's calls")
 
 _lock = locksan.lock("coll.mailbox")
 _cond = locksan.condition("coll.mailbox", _lock)
@@ -53,6 +68,16 @@ _slots: Dict[tuple, Any] = {}
 # destroy_collective_group, growing without bound across retried calls
 _born: Dict[tuple, float] = {}
 _next_sweep = [0.0]             # guarded by _lock
+# fenced epochs per group (guarded by _lock): chunks keyed with a fenced
+# (group, epoch) prefix are dropped instead of deposited. Bounded both
+# ways — per group (a group that reformed more than maxlen times has
+# long stopped receiving its oldest epochs' traffic) and across groups
+# (destroy fences on every teardown, so per-job group-name churn must
+# not grow the dict for the process lifetime; evicted groups' stale
+# stragglers fall back to the TTL sweep)
+_FENCED_PER_GROUP = 8
+_FENCED_GROUPS = 64
+_fenced: Dict[str, deque] = {}
 
 # plain per-process counters for tests/diagnostics (no shard-lock cost);
 # single-writer per field in practice (the rank thread / reader thread).
@@ -60,7 +85,8 @@ _next_sweep = [0.0]             # guarded by _lock
 # traffic that actually crosses the node plane (COLL_FWD), which is what
 # hierarchical schedules and the quantized wire format exist to shrink.
 _stats = {"sent_chunks": 0, "sent_bytes": 0, "recv_chunks": 0,
-          "recv_bytes": 0, "sent_remote_chunks": 0, "sent_remote_bytes": 0}
+          "recv_bytes": 0, "sent_remote_chunks": 0,
+          "sent_remote_bytes": 0, "fenced_chunks": 0}
 
 
 def payload_nbytes(payload) -> int:
@@ -107,12 +133,23 @@ def send(dest: Tuple[bytes, bytes], key: tuple, payload,
 
 
 def deposit(key: tuple, value) -> None:
-    """Reader-thread side: park an arrived chunk and wake waiters."""
+    """Reader-thread side: park an arrived chunk and wake waiters.
+    Chunks of a fenced (group, epoch) — traffic of an epoch a reform
+    already superseded — are dropped here instead of parked: no waiter
+    under the new epoch can ever key-match them, and without the fence
+    they would sit in the mailbox until the TTL sweep."""
     now = time.monotonic()
     # ring-only recorder hook BEFORE taking the mailbox lock (lock-free
     # append; the reader thread must never nest another lock here)
     flight_recorder.note_deliver(key, payload_nbytes(value))
     with _cond:
+        if len(key) >= 2:
+            fenced = _fenced.get(key[0])
+            if fenced is not None and key[1] in fenced:
+                _stats["fenced_chunks"] += 1
+                telemetry.counter_inc(M_COLL_FENCED, 1.0,
+                                      (("group", str(key[0])),))
+                return
         _slots[key] = value
         _born[key] = now
         if now >= _next_sweep[0]:
@@ -165,6 +202,48 @@ def flush() -> None:
     client = context.current_client
     if client is not None:
         client.conn.flush()
+
+
+def fence(group: str, epoch: str) -> int:
+    """Fence one (group, epoch): drop its undelivered chunks NOW and
+    refuse any further deposit keyed with it. Called by the reform path
+    BEFORE the survivors re-join (so nothing of the failing epoch can
+    cross into the new one) and by group teardown (so a dead member's
+    stranded traffic never waits on the TTL sweep). Returns the number
+    of chunks dropped at fence time; late arrivals count into
+    ``stats()["fenced_chunks"]`` as they are refused."""
+    dropped = 0
+    with _cond:
+        fenced = _fenced.get(group)
+        if fenced is None:
+            fenced = _fenced[group] = deque(maxlen=_FENCED_PER_GROUP)
+            while len(_fenced) > _FENCED_GROUPS:
+                _fenced.pop(next(iter(_fenced)))
+        if epoch not in fenced:
+            fenced.append(epoch)
+        for k in [k for k in _slots if k[:2] == (group, epoch)]:
+            del _slots[k]
+            _born.pop(k, None)
+            dropped += 1
+        if dropped:
+            _stats["fenced_chunks"] += dropped
+            telemetry.counter_inc(M_COLL_FENCED, float(dropped),
+                                  (("group", group),))
+        telemetry.gauge_set(M_COLL_INFLIGHT, float(len(_slots)))
+    return dropped
+
+
+def fenced_epochs(group: str) -> Tuple[str, ...]:
+    """Test/debug surface: the epochs currently fenced for a group."""
+    with _lock:
+        return tuple(_fenced.get(group) or ())
+
+
+def pending_keys() -> Tuple[tuple, ...]:
+    """Test/debug surface: keys of every undelivered chunk (the chaos
+    tests assert no stale-epoch key survives a reform)."""
+    with _lock:
+        return tuple(_slots)
 
 
 def drop_call(group: str, epoch: str, seq) -> None:

@@ -272,6 +272,42 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                      "int8-blockscale wire format; one "
                                      "float32 scale rides along per "
                                      "block"),
+    "collective_reform_mode": (str, "replace",
+                               "how a group heals after a dead-rank "
+                               "verdict: replace (wait for a restarted "
+                               "rank to re-enter with the same rank) | "
+                               "shrink (contract the world to the "
+                               "survivors, renumbered contiguously, "
+                               "once arrivals quiesce for the grace "
+                               "window)"),
+    "collective_reform_retries": (int, 2,
+                                  "reform+re-issue attempts the "
+                                  "fault-tolerant wrappers "
+                                  "(ft_allreduce / FaultTolerantGroup) "
+                                  "make per call before surfacing the "
+                                  "failure"),
+    "collective_reform_timeout_s": (float, 30.0,
+                                    "deadline of one reform round: in "
+                                    "replace mode, how long survivors "
+                                    "wait for the restarted "
+                                    "replacement rank to re-join "
+                                    "before the reform itself fails "
+                                    "with a clear error"),
+    "collective_reform_grace_s": (float, 5.0,
+                                  "shrink mode: the round resolves "
+                                  "once no new rank has re-joined for "
+                                  "this long — stragglers that arrive "
+                                  "within the window stay members"),
+    "actor_checkpoint_interval_calls": (int, 0,
+                                        "checkpoint an actor defining "
+                                        "save_checkpoint() every N "
+                                        "completed calls (captured "
+                                        "BEFORE the call's result is "
+                                        "reported, so an observed "
+                                        "completion implies checkpoint "
+                                        "durability); 0 = only on "
+                                        "demand via "
+                                        "ray_tpu.actor_checkpoint()"),
     "flight_recorder_capacity": (int, 4096,
                                  "event slots in the per-process "
                                  "collective flight-recorder ring "

@@ -18,6 +18,11 @@ current_actor_id = None
 current_accel_ids = None        # TPU slot indices assigned at dispatch
 in_worker: bool = False
 
+# Set by the worker runtime once it hosts an actor instance: the
+# callable behind ray_tpu.actor_checkpoint() (captures + persists the
+# actor's state now; see WorkerRuntime.checkpoint_now).
+actor_checkpoint_hook = None
+
 # Per-task namespace: a ContextVar so concurrent method calls of a
 # threaded/async actor each see their own submitter's namespace.
 current_namespace: contextvars.ContextVar = contextvars.ContextVar(

@@ -250,6 +250,14 @@ class GlobalControlPlane:
         # in-flight first execution must never be duplicated)
         self._sealed_once: set = set()
         self._reconstruct_claims: Dict[ObjectID, float] = {}
+        # successful claims per object, for the chaos tests' exactly-once
+        # assertion (a depth-N chain rebuilds each link once)
+        self._reconstruct_counts: Dict[ObjectID, int] = {}
+        # checkpointable actors: actor -> (seq, blob, ts). Latest only —
+        # the control plane holds the blob (NOT the checkpointing
+        # node's object store) so a node-death restart on another node
+        # still restores; GC'd when the actor reaches ACTOR_DEAD
+        self.actor_checkpoints: Dict[ActorID, tuple] = {}
         # specs of restartable actors whose node died, awaiting a
         # claimant (see claim_actor_reroute)
         self._actor_reroutes: Dict[ActorID, Any] = {}
@@ -465,6 +473,9 @@ class GlobalControlPlane:
             if state == ACTOR_DEAD and rec.spec.registered_name:
                 self.named_actors.pop(
                     (rec.spec.namespace, rec.spec.registered_name), None)
+            if state == ACTOR_DEAD:
+                # terminal: nothing will ever restore this checkpoint
+                self.actor_checkpoints.pop(actor_id, None)
         self.publish("ACTOR", {"actor_id": actor_id, "state": state,
                                "reason": reason})
 
@@ -477,6 +488,28 @@ class GlobalControlPlane:
         with self._lock:
             actor_id = self.named_actors.get((namespace, name))
             return self.actors.get(actor_id) if actor_id else None
+
+    # ------------------------------------------------ actor checkpoints
+    # Opt-in checkpointable-actor state (save_checkpoint/
+    # restore_checkpoint): one latest blob per actor, seq-guarded so a
+    # pre-death straggler's late save can never roll a restarted
+    # actor's newer snapshot back.
+
+    def save_actor_checkpoint(self, actor_id: ActorID, seq: int,
+                              blob: bytes) -> bool:
+        with self._lock:
+            cur = self.actor_checkpoints.get(actor_id)
+            if cur is not None and cur[0] >= seq:
+                return False
+            self.actor_checkpoints[actor_id] = (int(seq), bytes(blob),
+                                                time.time())
+        return True
+
+    def get_actor_checkpoint(self, actor_id: ActorID
+                             ) -> Optional[Tuple[int, bytes]]:
+        with self._lock:
+            cur = self.actor_checkpoints.get(actor_id)
+            return None if cur is None else (cur[0], cur[1])
 
     # Durable mutations journal INSIDE the plane lock: an append racing
     # a later append for the same key would otherwise persist in the
@@ -809,7 +842,9 @@ class GlobalControlPlane:
         if holders is None or holders or self.ref_pins.get(oid, 0) > 0:
             return None
         del self.ref_holders[oid]
-        # provenance and leak-sweep state die with the object
+        # provenance, leak-sweep and reconstruction-audit state die
+        # with the object
+        self._reconstruct_counts.pop(oid, None)
         self.obj_provenance.pop(oid, None)
         self._leaks.pop(oid, None)
         self._pinned_zero_since.pop(oid, None)
@@ -1046,7 +1081,24 @@ class GlobalControlPlane:
             if t is not None and now - t < claim_timeout_s:
                 return None
             self._reconstruct_claims[oid] = now
+            self._reconstruct_counts[oid] = (
+                self._reconstruct_counts.get(oid, 0) + 1)
+            # bounded audit trail: oldest rows fall off (claims are
+            # rare — node deaths — but a long-lived head must not
+            # accumulate a row per reconstructed object forever)
+            while len(self._reconstruct_counts) > 4096:
+                self._reconstruct_counts.pop(
+                    next(iter(self._reconstruct_counts)))
             return spec
+
+    def reconstruct_stats(self) -> Dict[str, int]:
+        """Successful lineage-reconstruction claims per object (hex) —
+        the claim gate's audit trail: the chaos tests assert each lost
+        link of a produce->transform->consume chain was rebuilt exactly
+        once."""
+        with self._lock:
+            return {oid.hex(): n
+                    for oid, n in self._reconstruct_counts.items()}
 
     # --------------------------------------------------------- snapshots
     # Explicit copies for state queries: both the in-process plane and the

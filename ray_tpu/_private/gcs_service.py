@@ -39,7 +39,8 @@ _ALLOWED = frozenset({
     "actors_snapshot", "directory_snapshot", "pgs_snapshot", "jobs_snapshot",
     "ref_register", "ref_drop", "drop_all_refs", "pin_task_args",
     "unpin_task_args", "pin_contained", "record_lineage", "get_lineage",
-    "claim_lineage",
+    "claim_lineage", "reconstruct_stats",
+    "save_actor_checkpoint", "get_actor_checkpoint",
     "record_provenance", "objects_info", "memory_state",
     "record_cluster_event", "list_cluster_events",
     "record_spans", "list_spans", "record_metrics", "metrics_snapshot",

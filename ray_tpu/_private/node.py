@@ -251,6 +251,13 @@ class _Waiter:
     fired: bool = False
     # cross-host driver GET: inline payload bytes into the reply metas
     fetch: bool = False
+    # registration time + next-probe stamp/backoff for the tick's
+    # stalled-waiter rescue (fruitless probes back off exponentially so
+    # waiters on genuinely still-running producers don't cost a plane
+    # lookup per oid per tick)
+    born: float = field(default_factory=time.monotonic)
+    probe_at: float = field(default_factory=lambda: time.monotonic() + 1.0)
+    probe_backoff: float = 1.0
 
 
 class _RemotePeer:
@@ -893,11 +900,72 @@ class NodeService:
         self._check_memory_pressure()
         self._retry_infeasible()
         self._spill_starved_pending()
+        self._rescue_stalled_waiters()
         self._sweep_stalls()
         self._sweep_object_leaks()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
         self._dispatch()
+
+    # concurrency: dispatcher-only
+    def _rescue_stalled_waiters(self) -> None:
+        """Self-heal the readiness plane: a get/wait waiter whose object
+        EXISTS can still be stranded — the register-time existence probe
+        can transiently miss (a remote owner's store peek failing or
+        timing out under load) AFTER the one OBJECT readiness event was
+        already consumed, leaving nothing to ever fire the waiter. The
+        tick re-probes waiters older than a beat with METADATA-ONLY
+        evidence (local store / control-plane directory — no peer store
+        RPC, so a tick stays cheap) and fires the ones that resolved;
+        ``_fire_get``'s lookup still pulls or fails loudly."""
+        if not self._get_waiters and not self._wait_waiters:
+            return
+        now = time.monotonic()
+        # plane probes per tick (a remote node's directory lookup is an
+        # RPC). A waiter too big for the REMAINING budget is skipped —
+        # never `return` — so one huge get can't monopolize every tick
+        # and starve a small stranded waiter behind it; oversized
+        # waiters (> the whole budget) rely on the normal event flow
+        # (the race this rescue closes strands few-oid waiters).
+        budget = 256
+        for waiter_id, waiter in (list(self._get_waiters.items())
+                                  + list(self._wait_waiters.items())):
+            if (now < waiter.probe_at or not waiter.remaining
+                    or len(waiter.remaining) > budget):
+                continue
+            budget -= len(waiter.remaining)
+            resolved = [oid for oid in waiter.remaining
+                        if self._oid_rescuable(oid)]
+            if not resolved:
+                # nothing there yet (producer still running): back off
+                # exponentially so steady-state cost per waiter decays
+                waiter.probe_backoff = min(waiter.probe_backoff * 2, 30.0)
+                waiter.probe_at = now + waiter.probe_backoff
+                continue
+            for oid in resolved:
+                waiter.remaining.discard(oid)
+                ids = self._obj_waiter_index.get(oid)
+                if ids is not None:
+                    ids.discard(waiter_id)
+                    if not ids:
+                        del self._obj_waiter_index[oid]
+            self._maybe_fire_waiter(waiter_id, waiter)
+
+    def _oid_rescuable(self, oid: ObjectID) -> bool:
+        """Cheap existence evidence for the waiter rescue: our store,
+        or a directory row (the object was sealed SOMEWHERE — for a
+        task we own, only once the task finished, so a waiter on an
+        in-flight retry is never fired early)."""
+        if self.store.contains(oid):
+            return True
+        tid = TaskID(TaskID.KIND + oid.binary()[:15])
+        owned = self._owned.get(tid)
+        if owned is not None and not owned.done:
+            return False        # still running: completion fires it
+        try:
+            return self.gcs.lookup_location(oid) is not None
+        except Exception:       # noqa: BLE001 — plane hiccup: next tick
+            return False
 
     def _sweep_stalls(self) -> None:
         """Trigger the control plane's stall detector. Only nodes
@@ -1890,6 +1958,20 @@ class NodeService:
                     self.gcs.record_metrics(ev_payload)
                 except Exception:   # noqa: BLE001 — telemetry best-effort
                     pass
+            elif ev_kind == "coll_reform":
+                # a rank process (worker/driver) reformed its collective
+                # group; it has no EventLogger of its own, so the
+                # literal emit lives here
+                try:
+                    fields = {k: v for k, v in dict(ev_payload).items()
+                              if k != "message"}
+                    self.events.warning(
+                        "COLLECTIVE_REFORM",
+                        str(ev_payload.get("message",
+                                           "collective group reformed")),
+                        **fields)
+                except Exception:   # noqa: BLE001 — accounting only
+                    pass
         elif op == P.GET_OBJECTS:
             self._get_objects(key, *payload)
         elif op == P.GET_OBJECTS_FETCH:
@@ -1947,6 +2029,25 @@ class NodeService:
         elif op == P.ACTOR_EXIT:
             actor_id, reason = payload
             self._local_kill_actor(actor_id, True, reason=reason or "exit_actor")
+        elif op == P.ACTOR_CHECKPOINT:
+            req_id, actor_id, seq, blob = payload
+            try:
+                # the plane's monotonic seq-guard verdict goes BACK to
+                # the worker: a rejected (stale) save must not read as
+                # durable there
+                ok = self.gcs.save_actor_checkpoint(actor_id, int(seq),
+                                                    bytes(blob))
+            except Exception as e:  # noqa: BLE001 — the worker blocks
+                self._reply(key, P.ERROR_REPLY, (req_id, to_bytes(e)))
+            else:
+                self._reply(key, P.INFO_REPLY, (req_id, ok))
+        elif op == P.ACTOR_CHECKPOINT_GET:
+            req_id, actor_id = payload
+            try:
+                ckpt = self.gcs.get_actor_checkpoint(actor_id)
+            except Exception:   # noqa: BLE001 — a miss restores nothing
+                ckpt = None
+            self._reply(key, P.INFO_REPLY, (req_id, ckpt))
         elif op == P.STATE_QUERY:
             req_id, what, filters = payload
             self._reply(key, P.INFO_REPLY,
@@ -4157,7 +4258,8 @@ class NodeService:
                      "name": rec.spec.registered_name,
                      "class_name": rec.spec.name,
                      "node_id": rec.node_id,
-                     "num_restarts": rec.num_restarts}
+                     "num_restarts": rec.num_restarts,
+                     "max_restarts": rec.spec.max_restarts}
                     for aid, rec in self.gcs.actors_snapshot()]
         if what == "objects":
             return self._memory_objects()
@@ -4192,6 +4294,10 @@ class NodeService:
             # so a scrape right after local activity is never stale
             telemetry.flush()
             return self.gcs.metrics_snapshot()
+        if what == "reconstruct_stats":
+            # lineage-reconstruction claim counts per object (the chaos
+            # tests assert a lost chain was rebuilt exactly once)
+            return self.gcs.reconstruct_stats()
         return None
 
     def _memory_objects(self, with_leaks: bool = False):

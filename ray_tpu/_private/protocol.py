@@ -174,6 +174,20 @@ CLUSTER_COLL = 79       # any client -> node: (req_id, what, timeout_s)
 # who made it and from where.
 OBJ_PROVENANCE = 80     # [(ObjectID, callsite, creator), ...]
 
+# Checkpointable actors (reference analogue: the GCS-backed actor
+# checkpointing of gcs.proto's ActorCheckpointData — state captured by
+# an opt-in save_checkpoint()/restore_checkpoint(state) protocol). The
+# blob lives in the CONTROL PLANE, not the checkpointing node's object
+# store: a checkpoint must survive the death of the very node that
+# wrote it, or a node-death restart restores nothing.
+ACTOR_CHECKPOINT = 81       # (req_id, ActorID, seq, blob) -> INFO_REPLY
+                            # True once the plane holds it (the worker
+                            # blocks: a reported completion implies its
+                            # checkpoint is durable)
+ACTOR_CHECKPOINT_GET = 82   # (req_id, ActorID) -> INFO_REPLY
+                            # (seq, blob) | None — replayed into a
+                            # restarted actor before queued calls drain
+
 # Generic coalesced frame: (BATCH, [(op, payload), ...]). Produced by
 # the Connection writer when several messages are pending at flush time
 # — ONE pickle stream + one frame + one receiver wakeup for the burst —

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import inspect
+import itertools
 import os
 import signal
 import sys
@@ -28,12 +29,25 @@ from typing import Any, Dict, List, Optional
 
 from .. import exceptions
 from . import context
+from . import failpoints
 from . import protocol as P
+from . import telemetry
 from .client import CoreClient
 from .config import CONFIG
 from .ids import JobID, NodeID, ObjectID, WorkerID
 from .object_store import ObjectMeta, create_segment
 from . import serialization as ser
+
+M_ACTOR_CKPTS = telemetry.define(
+    "counter", "rtpu_actor_checkpoints_total",
+    "Actor state snapshots captured by this worker (periodic per "
+    "actor_checkpoint_interval_calls, or on demand via "
+    "ray_tpu.actor_checkpoint()) and persisted in the control plane")
+M_ACTOR_RESTORES = telemetry.define(
+    "counter", "rtpu_actor_restores_total",
+    "Restarted actors whose state was replayed from their latest "
+    "checkpoint (restore_checkpoint ran before any queued call) "
+    "instead of starting empty from __init__")
 
 
 class WorkerRuntime:
@@ -73,6 +87,14 @@ class WorkerRuntime:
         self._pool: Optional[ThreadPoolExecutor] = None
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._current_task_thread: Optional[int] = None
+        # checkpointable-actor bookkeeping: atomic snapshot-sequence
+        # allocator (itertools.count — concurrent on-demand checkpoints
+        # from a threaded actor get distinct seqs without a lock;
+        # re-seeded past the restored checkpoint so a restart never
+        # allocates behind the plane) and completed calls since the
+        # last capture (the periodic trigger)
+        self._ckpt_counter = itertools.count(1)
+        self._ckpt_calls = 0
 
     # ------------------------------------------------------------ main loop
     def run(self) -> None:
@@ -240,17 +262,26 @@ class WorkerRuntime:
                 if kind == "task":
                     fn = self._get_function(spec.function_id)
                     args, kwargs = self._load_args(spec, deps)
+                    failpoints.fp("worker.task.begin", name=spec.name)
                     result = fn(*args, **kwargs)
                 elif kind == "actor_create":
                     result = self._create_actor(actor_spec, spec, deps)
                 else:  # actor_call
                     args, kwargs = self._load_args(spec, deps)
+                    failpoints.fp("actor.call.begin",
+                                  method=spec.method_name, name=spec.name)
                     method = getattr(self._actor_instance, spec.method_name)
                     result = method(*args, **kwargs)
                     if inspect.iscoroutine(result):
                         # sync actor defining an async method: run it here
                         result = asyncio.new_event_loop(
                         ).run_until_complete(result)
+            if kind == "actor_call":
+                # BEFORE the result is reported: a completion the
+                # caller observed is never newer than the checkpoint a
+                # restart would restore (a capture failure fails the
+                # call — resuming silently behind would break that)
+                self._maybe_checkpoint()
             self._send_done(spec, kind, result, None)
         except BaseException as e:  # noqa: BLE001
             self._send_done(spec, kind, None, e)
@@ -330,7 +361,89 @@ class WorkerRuntime:
             self._pool = ThreadPoolExecutor(
                 max_workers=actor_spec.max_concurrency)
         self._actor_instance = cls(*args, **kwargs)
+        self._restore_checkpoint(actor_spec)
+        context.actor_checkpoint_hook = self.checkpoint_now
         return None
+
+    # ------------------------------------------ checkpointable actors
+    # Opt-in protocol: a class defining ``save_checkpoint(self) ->
+    # state`` (and, to resume, ``restore_checkpoint(self, state)``) is
+    # checkpointable. Capture is periodic (every
+    # ``actor_checkpoint_interval_calls`` completed calls) or on demand
+    # (``ray_tpu.actor_checkpoint()`` inside a method); the blob lives
+    # in the control plane keyed by actor id, so the SAME id restored
+    # after a worker- or node-level restart finds it. Restore runs
+    # inside the (re-)creation task — strictly before any queued call
+    # drains, so a restarted rank resumes at its last checkpointed
+    # step, not from __init__.
+
+    def _restore_checkpoint(self, actor_spec: P.ActorSpec) -> None:
+        inst = self._actor_instance
+        if not (hasattr(inst, "restore_checkpoint")
+                or hasattr(inst, "save_checkpoint")):
+            return
+        ckpt = self.client.get_actor_checkpoint(actor_spec.actor_id)
+        if ckpt is None:
+            return                      # first creation: nothing saved
+        seq, blob = ckpt
+        # resume the sequence even for save-only classes: a restarted
+        # incarnation restarting at seq 1 would have every later save
+        # rejected by the plane's monotonic guard
+        self._ckpt_counter = itertools.count(int(seq) + 1)
+        if hasattr(inst, "restore_checkpoint"):
+            inst.restore_checkpoint(ser.from_bytes(bytes(blob)))
+            telemetry.counter_inc(M_ACTOR_RESTORES)
+
+    def _maybe_checkpoint(self) -> None:
+        inst = self._actor_instance
+        if inst is None or not hasattr(inst, "save_checkpoint"):
+            return
+        if self._pool is not None or self._aio_loop is not None:
+            # concurrent actors (max_concurrency>1 / async) have no
+            # quiescent point between calls: an automatic snapshot here
+            # could serialize state another call is mid-mutating (and
+            # the async path never reaches this method at all) — such
+            # actors checkpoint on demand at points THEY know are safe
+            return
+        every = CONFIG.actor_checkpoint_interval_calls
+        self._ckpt_calls += 1
+        if every > 0 and self._ckpt_calls >= every:
+            self.checkpoint_now()
+
+    def checkpoint_now(self) -> int:
+        """Capture + persist the actor's state; returns the durable
+        snapshot's sequence number (the ray_tpu.actor_checkpoint()
+        hook). A threaded actor may call this concurrently without
+        breaking anything mechanical (seqs are allocated atomically,
+        BEFORE the capture, and a rejected save never overwrites a
+        newer one) — but the ORDER of two overlapping captures is
+        inherently ambiguous: each call guarantees only that a
+        snapshot at least as new as its own is durable. An actor that
+        needs strict capture ordering must serialize its own
+        checkpoint points (which 'checkpoint at points YOU know are
+        safe' already implies)."""
+        inst = self._actor_instance
+        if inst is None or self._actor_spec is None:
+            raise RuntimeError("no actor instance in this worker")
+        if not hasattr(inst, "save_checkpoint"):
+            raise RuntimeError(
+                f"actor {type(inst).__name__} defines no "
+                "save_checkpoint() — the checkpoint protocol is opt-in")
+        aid = self._actor_spec.actor_id
+        # seq BEFORE capture: allocation order then matches capture
+        # START order, so a capture that began later (and may contain
+        # later mutations) can never persist under a LOWER seq
+        seq = next(self._ckpt_counter)
+        blob = ser.to_bytes(inst.save_checkpoint())
+        if not self.client.save_actor_checkpoint(aid, seq, blob):
+            cur = self.client.get_actor_checkpoint(aid)
+            seq = int(cur[0]) if cur is not None else 0
+            # re-seed so the NEXT capture strictly supersedes whatever
+            # is there (benign if a concurrent caller re-seeds too)
+            self._ckpt_counter = itertools.count(seq + 1)
+        self._ckpt_calls = 0
+        telemetry.counter_inc(M_ACTOR_CKPTS)
+        return seq
 
     def _get_function(self, function_id: bytes):
         fn = self._functions.get(function_id)

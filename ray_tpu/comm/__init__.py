@@ -17,17 +17,23 @@ device mesh — the SPMD-vs-actor bridge (SURVEY §7 "hard parts").
 
 from .collective import (  # noqa: F401
     CollectiveActorMixin,
+    CollectiveTimeoutError,
+    FaultTolerantGroup,
     allgather,
     allreduce,
     barrier,
     broadcast,
     create_collective_group,
     destroy_collective_group,
+    ensure_collective_group,
+    ft_allreduce,
+    ft_collective,
     get_rank,
     get_collective_group_size,
     init_collective_group,
     recv,
     reducescatter,
+    reform_collective_group,
     send,
 )
 from .device_mesh import MeshGroup, mesh_group  # noqa: F401

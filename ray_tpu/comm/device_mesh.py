@@ -129,11 +129,12 @@ class MeshGroup:
     def shutdown(self) -> None:
         from .. import kill
         if self.collective_group is not None:
-            # rank 0's process owns the coordinator actor; ask it to
-            # tear the group down before the gang dies
+            # any member can tear the group down (destroy fences the
+            # epoch, sweeps stranded chunks and kills the coordinator);
+            # bounded so a dead rank 0 can't hang the gang's teardown
             try:
                 get(self._actors[0]._rtpu_destroy_collective.remote(
-                    self.collective_group))
+                    self.collective_group), timeout=15.0)
             except Exception:
                 pass
         for a in self._actors:

@@ -56,6 +56,12 @@ Rules (each has a golden-fixture test in tests/test_concurrency_lint.py):
     ``RTPU_<NAME>``; stale/duplicate rows and ``CONFIG.<typo>`` reads
     of undefined knobs are findings.
 
+(g) **Failpoint-site registry.** Every ``failpoints.fp(<site>)`` call
+    must name a literal site registered in ``failpoints._SITES`` (a
+    typo'd site silently never fires), and every registered site must
+    have at least one planted call site (a stale row documents chaos
+    coverage that doesn't exist).
+
 Wired into tier-1 (``tests/test_concurrency_lint.py``); standalone:
 ``python -m ray_tpu.scripts.check_concurrency`` (also via ``rtpu lint``).
 """
@@ -1197,6 +1203,66 @@ def check_config_registry(files, readme_path: str) -> List[str]:
     return problems
 
 
+# ===================================================== rule (g): failpoints
+
+def check_failpoint_registry(files) -> List[str]:
+    """Failpoint sites are registry-linted like config knobs: fp() call
+    sites and failpoints._SITES must agree both directions."""
+    problems: List[str] = []
+    sites: Optional[tuple] = None
+    for rel, tree, _lines in files:
+        if not rel.endswith("failpoints.py"):
+            continue
+        for node in ast.walk(tree):
+            tgt = val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                tgt, val = node.target, node.value
+            if (isinstance(tgt, ast.Name) and tgt.id == "_SITES"
+                    and val is not None):
+                try:
+                    sites = tuple(ast.literal_eval(val))
+                except (ValueError, SyntaxError):
+                    sites = None
+        break
+    if sites is None:
+        return ["no _SITES tuple found in failpoints.py — the "
+                "failpoint-registry scanner is broken"]
+    planted: Dict[str, List[tuple]] = {}
+    for rel, tree, _lines in files:
+        if rel.endswith("failpoints.py"):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "fp"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "failpoints"):
+                continue
+            if (not node.args
+                    or not isinstance(node.args[0], ast.Constant)
+                    or not isinstance(node.args[0].value, str)):
+                problems.append(
+                    f"{rel}:{node.lineno}: failpoints.fp() called with "
+                    "a non-literal site — the registry lint can't see "
+                    "it")
+                continue
+            site = node.args[0].value
+            planted.setdefault(site, []).append((rel, node.lineno))
+            if site not in sites:
+                problems.append(
+                    f"{rel}:{node.lineno}: failpoint site {site!r} is "
+                    "not registered in failpoints._SITES")
+    for site in sorted(set(sites) - set(planted)):
+        problems.append(
+            f"failpoint site {site!r}: registered in "
+            "failpoints._SITES but never planted (no "
+            "failpoints.fp() call site) — stale registry row")
+    return problems
+
+
 # ================================================================== driver
 
 def analyze(repo_root: Optional[str] = None) -> _Analyzer:
@@ -1219,6 +1285,7 @@ def check(repo_root: Optional[str] = None,
     problems += check_protocol_ops(an.files, an.funcs)
     problems += check_config_registry(an.files,
                                       os.path.join(root, "README.md"))
+    problems += check_failpoint_registry(an.files)
     return problems
 
 

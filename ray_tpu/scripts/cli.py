@@ -333,6 +333,21 @@ def cmd_doctor(client, args) -> None:
         print(f"  STALL [{ev.get('cause')}] {ev.get('message')}")
     for v in (rep.get("collectives") or {}).get("verdicts", []):
         print(f"  COLLECTIVE [{v.get('verdict')}] {v.get('message')}")
+    rec = rep.get("recovery") or {}
+    if any((rec.get("collective_reforms"), rec.get("actor_restores"),
+            rec.get("actor_checkpoints"),
+            rec.get("exhausted_restart_budgets"))):
+        print("recovery: "
+              f"{rec.get('collective_reforms', 0):g} group reform(s), "
+              f"{rec.get('actor_checkpoints', 0):g} checkpoint(s), "
+              f"{rec.get('actor_restores', 0):g} actor restore(s)")
+        for ev in rec.get("recent_reforms", []):
+            print(f"  REFORM {ev.get('message')}")
+        for a in rec.get("exhausted_restart_budgets", []):
+            print(f"  ! actor {str(a.get('actor_id'))[:12]} "
+                  f"({a.get('class_name')}) dead after "
+                  f"{a.get('num_restarts')} restart(s) — budget "
+                  "exhausted")
     for ev in rep["alerts"]:
         print(f"  {ev.get('severity')} [{ev.get('label')}] "
               f"{ev.get('message')}")

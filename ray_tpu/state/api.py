@@ -56,6 +56,7 @@ def shape_actors(recs: List[dict]) -> List[dict]:
         "name": rec.get("name"),
         "state": rec["state"],
         "num_restarts": rec.get("num_restarts", 0),
+        "max_restarts": rec.get("max_restarts", 0),
     } for rec in recs or []]
 
 
@@ -371,7 +372,8 @@ def health_report() -> Dict[str, Any]:
     avail = client.cluster_info("resources_available") or {}
     tasks = shape_tasks(_query("tasks"))
     task_summary = summarize_task_rows(tasks)
-    actor_summary = summarize_actor_rows(shape_actors(_query("actors")))
+    actor_rows = shape_actors(_query("actors"))
+    actor_summary = summarize_actor_rows(actor_rows)
     events = _query("cluster_events") or []
     recent = events[-500:]
     # a stall is a problem only while its task is still non-terminal:
@@ -413,6 +415,35 @@ def health_report() -> Dict[str, Any]:
     dropped = metrics.get("rtpu_telemetry_dropped_series_total") or {}
     if dropped.get("total"):
         highlights["dropped_metric_series"] = dropped["total"]
+
+    # recovery: did the self-healing machinery run, and did any budget
+    # run dry? (reforms + actor checkpoint/restore counters from the
+    # merged telemetry table; recent COLLECTIVE_REFORM/ACTOR_REROUTE
+    # events; actors that died with restarts consumed = a budget that
+    # was exhausted rather than never used)
+    def _ctr(name: str) -> float:
+        return (metrics.get(name) or {}).get("total", 0) or 0
+
+    recovery = {
+        "collective_reforms": _ctr("rtpu_collective_reforms_total"),
+        "fenced_stale_chunks": _ctr("rtpu_collective_fenced_chunks_total"),
+        "actor_checkpoints": _ctr("rtpu_actor_checkpoints_total"),
+        "actor_restores": _ctr("rtpu_actor_restores_total"),
+        "recent_reforms": [e for e in recent
+                           if e.get("label") == "COLLECTIVE_REFORM"][-10:],
+        "recent_actor_reroutes": [e for e in recent
+                                  if e.get("label") == "ACTOR_REROUTE"][-10:],
+        # exhausted = died having CONSUMED its whole (non-empty, finite)
+        # budget: a deliberately-killed actor mid-budget, or one that
+        # never had restarts, is not a crash loop worth flagging
+        "exhausted_restart_budgets": [
+            {"actor_id": a.get("actor_id"),
+             "class_name": a.get("class_name"),
+             "num_restarts": a.get("num_restarts", 0)}
+            for a in actor_rows
+            if a.get("state") == "DEAD"
+            and 0 < a.get("max_restarts", 0) <= a.get("num_restarts", 0)],
+    }
 
     dead_nodes = [n for n in nodes if not n.get("alive")]
     by_state = task_summary.get("by_state", {})
@@ -456,6 +487,7 @@ def health_report() -> Dict[str, Any]:
                    "bytes": sum(r.get("size") or 0 for r in mem_rows),
                    "leaked": len(leaks),
                    "leaks": leaks[:10]},
+        "recovery": recovery,
         "metrics": highlights,
     }
 

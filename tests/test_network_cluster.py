@@ -573,6 +573,213 @@ def test_cross_node_hang_diagnosis_names_dead_rank(tcp_cluster):
         assert "dead rank 3" in msg and "allreduce" in msg, msg
 
 
+def test_recursive_lineage_reconstruction_chain(tcp_cluster):
+    """A depth-2 produce -> transform -> consume chain whose
+    intermediate AND leaf objects die with their node is rebuilt by
+    ``_maybe_reconstruct`` recursing through the lost creating-task
+    args — and the claim gate admits exactly ONE reconstruction per
+    object (counter-audited) despite multiple observers of the loss."""
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    victim = tcp_cluster.add_node(num_cpus=2)
+    _wait_for_nodes(2)
+    affinity = NodeAffinitySchedulingStrategy(
+        node_id=NodeID.from_hex(victim.node_id_hex), soft=True)
+
+    @ray_tpu.remote(max_retries=3, scheduling_strategy=affinity)
+    def produce():
+        return np.arange(60_000, dtype=np.float64)        # ~480 KB
+
+    @ray_tpu.remote(max_retries=3, scheduling_strategy=affinity)
+    def transform(x):
+        return x * 2.0
+
+    a = produce.remote()
+    b = transform.remote(a)
+    # materialize BOTH links on the victim (sealed -> reconstructable)
+    out = ray_tpu.get(b, timeout=60)
+    assert float(out[-1]) == (60_000 - 1) * 2.0
+
+    tcp_cluster.remove_node(victim)          # hard SIGKILL: a AND b lost
+
+    @ray_tpu.remote
+    def consume(x):
+        return float(x.sum())
+
+    want = float((np.arange(60_000, dtype=np.float64) * 2.0).sum())
+    got = ray_tpu.get(consume.remote(b), timeout=120)
+    assert got == pytest.approx(want)
+    # the whole chain was rebuilt exactly once per lost object: the
+    # claim gate admitted one reconstruction of b AND one of a (the
+    # recursion through the transform spec's lost arg)
+    client = ray_tpu._ctx.require_client()
+    stats = client.state_query("reconstruct_stats") or {}
+    a_hex = a.id.hex()
+    b_hex = b.id.hex()
+    assert stats.get(b_hex) == 1, stats
+    assert stats.get(a_hex) == 1, stats
+
+
+def test_chaos_training_loop_survives_rank_kill_mid_allreduce(tcp_cluster):
+    """ISSUE-12 acceptance, 2 OS-isolated nodes: a training-style loop
+    (checkpointable actor ranks, allreduce per step) survives a SIGKILL
+    of one rank mid-allreduce — the group reforms under a fresh epoch
+    (metric + COLLECTIVE_REFORM event observed), the restarted rank
+    resumes from its last checkpoint, the loop reaches step N with
+    bit-correct results, and no stale-epoch chunk survives into the new
+    epoch (fence assertion on every rank)."""
+    from ray_tpu import state as rstate
+    from ray_tpu.comm import collective as col
+
+    tcp_cluster.add_node(num_cpus=2, resources={"side": 2.0})
+    _wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=0, max_restarts=2)
+    class TrainRank(col.CollectiveActorMixin):
+        def __init__(self, world, rank):
+            from ray_tpu._private.config import CONFIG
+            CONFIG._values["actor_checkpoint_interval_calls"] = 1
+            CONFIG._values["collective_reform_timeout_s"] = 45.0
+            self.world, self.rank = world, rank
+            self.step = 0
+            self.acc = None
+            self.restored_at = None
+            self.epochs = []
+
+        def save_checkpoint(self):
+            return {"step": self.step, "acc": self.acc}
+
+        def restore_checkpoint(self, state):
+            self.step = state["step"]
+            self.acc = state["acc"]
+            self.restored_at = state["step"]
+
+        def arm(self, spec):
+            from ray_tpu._private import failpoints
+            failpoints.activate(spec)
+            return True
+
+        def train_step(self, i):
+            col.ensure_collective_group(self.world, self.rank, "chaos")
+            if self.step > i:
+                return self.step
+            ep = col._groups()["chaos"].epoch
+            if ep not in self.epochs:
+                self.epochs.append(ep)
+            # 1.5 MB float32: >= the hierarchical threshold on the
+            # 2-node x 2-rank topology AND two pipeline chunks, so the
+            # armed chunk=1 failpoint fires with chunk 0 already in
+            # flight — a genuine mid-op death
+            grad = np.full(393_216, float((i + 1) * (self.rank + 1)),
+                           np.float32)
+            out = col.ft_allreduce(grad, group_name="chaos", timeout=6.0)
+            self.acc = out if self.acc is None else self.acc + out
+            self.step = i + 1
+            return self.step
+
+        def report(self):
+            import hashlib
+            from ray_tpu._private import coll_transport
+            stale = [k for k in coll_transport.pending_keys()
+                     if len(k) >= 2 and k[0] == "chaos"
+                     and k[1] in self.epochs[:-1]]
+            digest = (hashlib.sha256(self.acc.tobytes()).hexdigest()
+                      if self.acc is not None else None)
+            return {"step": self.step, "digest": digest,
+                    "restored_at": self.restored_at,
+                    "epochs": list(self.epochs), "stale": stale,
+                    "fenced": [e for e in self.epochs[:-1]
+                               if e in coll_transport.fenced_epochs(
+                                   "chaos")]}
+
+    members = ([TrainRank.remote(4, r) for r in range(2)]
+               + [TrainRank.options(resources={"side": 1.0}).remote(4, r)
+                  for r in (2, 3)])
+    # rank 3 (second OS node, a non-leader) dies MID-allreduce of step
+    # 2 (seq=2): chunk 0 of its phase-1 contribution is already in
+    # flight up the local tree, chunk 1 never leaves — survivors wedge
+    # inside the same op with rank 3's partial traffic in the air (the
+    # fence's job), and the whole step retries aligned after the reform
+    ray_tpu.get(members[3].arm.remote(
+        "coll.hier.phase=kill@phase=up&chunk=1&seq=2"), timeout=60)
+
+    def drive(i):
+        pending = {idx: m.train_step.remote(i)
+                   for idx, m in enumerate(members)}
+        results = {}
+        deadline = time.monotonic() + 150
+        while pending:
+            assert time.monotonic() < deadline, (
+                f"step {i} wedged; pending {sorted(pending)}")
+            for idx, ref in list(pending.items()):
+                ready, _ = ray_tpu.wait([ref], timeout=0.5)
+                if not ready:
+                    continue
+                try:
+                    results[idx] = ray_tpu.get(ready[0])
+                    del pending[idx]
+                except Exception:        # killed rank: re-issue, the
+                    pending[idx] = (     # restarted actor resumes
+                        members[idx].train_step.remote(i))
+        return results
+
+    N = 4
+    for i in range(N):
+        assert set(drive(i).values()) == {i + 1}
+
+    reports = ray_tpu.get([m.report.remote() for m in members],
+                          timeout=60)
+    # bit-correct on every rank: one shared digest, steps complete
+    digests = {r["digest"] for r in reports}
+    assert len(digests) == 1 and None not in digests
+    acc = None
+    for i in range(N):
+        out = np.full(393_216, 0.0, np.float32)
+        for rank in range(4):
+            out = out + np.full(393_216, float((i + 1) * (rank + 1)),
+                                np.float32)
+        acc = out if acc is None else acc + out
+    import hashlib
+    assert digests == {hashlib.sha256(acc.tobytes()).hexdigest()}
+    for r in reports:
+        assert r["step"] == N
+    # the killed rank resumed FROM ITS CHECKPOINT at step 2
+    assert reports[3]["restored_at"] == 2
+    assert all(r["restored_at"] is None for r in reports[:3])
+    # the group reformed under ONE fresh epoch: survivors saw exactly
+    # [old, new] (old fenced), the restarted rank only ever saw the new
+    # one, and NO stale-epoch chunk survives in anyone's mailbox
+    new_epochs = {r["epochs"][-1] for r in reports}
+    assert len(new_epochs) == 1
+    for r in reports:
+        assert r["stale"] == []
+    for r in reports[:3]:                # survivors fenced the old epoch
+        assert len(r["epochs"]) == 2, r["epochs"]
+        assert r["fenced"] == [r["epochs"][0]]
+    assert reports[3]["epochs"] == [reports[0]["epochs"][1]]
+
+    # observability: reform metric + COLLECTIVE_REFORM event crossed
+    # the cluster into the merged table / event ring
+    deadline = time.monotonic() + 20
+    reforms = 0
+    while time.monotonic() < deadline:
+        s = rstate.summarize_metrics()
+        reforms = (s.get("rtpu_collective_reforms_total") or {}).get(
+            "total", 0)
+        restores = (s.get("rtpu_actor_restores_total") or {}).get(
+            "total", 0)
+        if reforms >= 3 and restores >= 1:
+            break
+        time.sleep(0.3)
+    assert reforms >= 3 and restores >= 1
+    evs = [e for e in rstate.list_cluster_events()
+           if e.get("label") == "COLLECTIVE_REFORM"]
+    assert evs and evs[-1].get("group") == "chaos"
+    assert evs[-1].get("mode") == "replace"
+
+
 def test_cross_node_ring_collective(tcp_cluster):
     """Ring collective whose chunks actually cross the wire: one rank
     per OS-isolated node, payload above the tree threshold, so every
