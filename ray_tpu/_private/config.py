@@ -298,6 +298,18 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                   "once no new rank has re-joined for "
                                   "this long — stragglers that arrive "
                                   "within the window stay members"),
+    "actor_checkpoint_interval_s": (float, 0.0,
+                                    "checkpoint an actor defining "
+                                    "save_checkpoint() when at least "
+                                    "this many seconds have passed "
+                                    "since the last capture, checked "
+                                    "at each call completion (the "
+                                    "worker's safe quiescent point — "
+                                    "idle actors mutate no state, so "
+                                    "no between-call tick is needed); "
+                                    "rides the same seq-guarded plane "
+                                    "path as the call-count trigger. "
+                                    "0 disables the time trigger"),
     "actor_checkpoint_interval_calls": (int, 0,
                                         "checkpoint an actor defining "
                                         "save_checkpoint() every N "
@@ -324,6 +336,23 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                     "chunks of this size (reference: "
                                     "object_manager chunked Push/Pull)"),
     "grpc_equivalent_port": (int, 0, "tcp port for the head control plane (0 = unix socket)"),
+    # --- serve request observability ---
+    "request_log_capacity": (int, 256,
+                             "per-replica structured access-log ring "
+                             "slots (request_id, route, status, "
+                             "latency, queue wait, batch size); 0 "
+                             "disables the whole request-observability "
+                             "plane — no request metadata attaches, no "
+                             "ingress/queue/replica spans, no "
+                             "digests, restoring the pre-PR request "
+                             "hot path"),
+    "serve_slow_request_threshold_s": (float, 1.0,
+                                       "serve requests slower than "
+                                       "this are promoted to a "
+                                       "SLOW_REQUEST cluster event "
+                                       "(errors always promote as "
+                                       "REQUEST_ERROR); 0 disables "
+                                       "slow-request promotion"),
     # --- lineage ---
     "max_lineage_bytes": (int, 100 * (1 << 20),
                           "lineage footprint cap (reference: task_manager.h:180)"),
@@ -368,8 +397,21 @@ class _Config:
         except KeyError:
             raise AttributeError(name) from None
 
+    def __reduce__(self):
+        # the singleton must never ship by value: function/class blobs
+        # pickled by value (cloudpickle) capture any CONFIG global
+        # their bodies reference, and a value-pickled _Config would (a)
+        # hit __getattr__ recursion before _values exists on unpickle
+        # and (b) freeze the ORIGIN process's table into the
+        # destination. Resolve to the destination's own singleton.
+        return (_current_config, ())
+
     def dump(self) -> Dict[str, Any]:
         return dict(self._values)
+
+
+def _current_config() -> "_Config":
+    return CONFIG
 
 
 CONFIG = _Config()

@@ -28,6 +28,23 @@ actor_checkpoint_hook = None
 current_namespace: contextvars.ContextVar = contextvars.ContextVar(
     "rtpu_namespace", default=None)
 
+# Request-scoped baggage riding the task spec (reference analogue: W3C
+# trace baggage / Serve's request context): a submitter binds a compact
+# tuple here and the next submissions carry it in spec.request_ctx —
+# INSIDE the one spec pickle stream, not as an extra arg slot (an arg
+# slot costs a separate pickle + load per call; the request_ab overhead
+# gate prices this path). Workers re-bind it around task execution, so
+# the whole nested call tree of one serve request shares the baggage.
+request_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_request_ctx", default=None)
+
+# Monotonic receive stamp of the actor call carrying request baggage
+# (set by the worker beside request_ctx, only for requests): the
+# replica's skew-free fallback for queue-wait when cross-node wall
+# clocks disagree (enqueued_at comes from the HANDLE's clock).
+request_recv_t: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_request_recv_t", default=None)
+
 
 def active_namespace() -> str:
     ns = current_namespace.get()

@@ -204,7 +204,15 @@ class GlobalControlPlane:
         # scrape target aggregating per-node MetricsAgents)
         self.metrics_counters: Dict[tuple, float] = {}
         self.metrics_gauges: Dict[tuple, tuple] = {}      # key -> (val, ts)
+        # retired gauge series -> delete-marker ts: a straggling publish
+        # from the dying process (its flusher racing the delete) must
+        # not resurrect a popped series, so older-ts values are refused
+        # until a genuinely newer set re-creates it
+        self._gauge_tombstones: Dict[tuple, float] = {}
         self.metrics_hists: Dict[tuple, dict] = {}
+        # key -> digest payload (centroids/count/sum/min/max); merged by
+        # the t-digest fold, so per-process quantile sketches combine
+        self.metrics_digests: Dict[tuple, dict] = {}
         self.metrics_meta: Dict[str, dict] = {}
         # distinct series refused (cardinality cap) / bucket-conflicted:
         # sets, not event counters — every flush retries the same key
@@ -1342,7 +1350,8 @@ class GlobalControlPlane:
         if key in table:
             return True
         if (len(self.metrics_counters) + len(self.metrics_gauges)
-                + len(self.metrics_hists)) >= CONFIG.metric_series_limit:
+                + len(self.metrics_hists)
+                + len(self.metrics_digests)) >= CONFIG.metric_series_limit:
             self._metrics_dropped_keys.add(key)
             return False
         return True
@@ -1362,6 +1371,26 @@ class GlobalControlPlane:
                     self.metrics_counters[key] = (
                         self.metrics_counters.get(key, 0.0) + delta)
             for key, vt in (payload.get("gauges") or {}).items():
+                if vt[0] != vt[0]:
+                    # NaN delete marker (telemetry.gauge_delete): the
+                    # series' subject is gone — forget the series
+                    # instead of exporting the marker, and tombstone
+                    # the key so an older in-flight publish can't
+                    # re-insert it
+                    self.metrics_gauges.pop(key, None)
+                    self._gauge_tombstones[key] = max(
+                        vt[1], self._gauge_tombstones.get(key, 0.0))
+                    if len(self._gauge_tombstones) > 1024:
+                        for k in sorted(self._gauge_tombstones,
+                                        key=self._gauge_tombstones.get
+                                        )[:512]:
+                            del self._gauge_tombstones[k]
+                    continue
+                dead_ts = self._gauge_tombstones.get(key)
+                if dead_ts is not None:
+                    if vt[1] <= dead_ts:
+                        continue            # straggler from a retiree
+                    del self._gauge_tombstones[key]   # re-created
                 if not self._metric_series_ok(self.metrics_gauges, key):
                     continue
                 old = self.metrics_gauges.get(key)
@@ -1392,6 +1421,11 @@ class GlobalControlPlane:
                     cur["count"] += h["count"]
                     cur["counts"][-1] += int(h["count"])
                     self._metrics_conflict_keys.add(key)
+            for key, d in (payload.get("digests") or {}).items():
+                if self._metric_series_ok(self.metrics_digests, key):
+                    from . import telemetry as _tm
+                    self.metrics_digests[key] = _tm.merge_digest_payloads(
+                        self.metrics_digests.get(key), d)
 
     def metrics_snapshot(self) -> dict:
         with self._lock:
@@ -1400,6 +1434,8 @@ class GlobalControlPlane:
                 "gauges": dict(self.metrics_gauges),
                 "hists": {k: {**v, "counts": list(v["counts"])}
                           for k, v in self.metrics_hists.items()},
+                "digests": {k: dict(v)
+                            for k, v in self.metrics_digests.items()},
                 "meta": {k: dict(v) for k, v in self.metrics_meta.items()},
                 "dropped_series": (len(self._metrics_dropped_keys)
                                    + len(self._metrics_conflict_keys)),

@@ -14,13 +14,16 @@ class JsonHandler(BaseHTTPRequestHandler):
     def log_message(self, *args):   # quiet
         pass
 
-    def _json(self, code: int, payload) -> None:
+    def _json(self, code: int, payload,
+              headers: Optional[dict] = None) -> None:
         # default=str: handler results may carry numpy scalars/bytes —
         # stringify rather than turning a good reply into a 500
         body = json.dumps(payload, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 

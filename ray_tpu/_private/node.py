@@ -80,6 +80,10 @@ class _Worker:
     env_key: str = ""
     idle_since: float = 0.0
     log_path: Optional[str] = None
+    # human name for log attribution (SET_LOG_LABEL — e.g. a serve
+    # replica's "deployment#tag"); rides every published LOG batch so
+    # driver-side prefixes are greppable by deployment
+    log_label: Optional[str] = None
     # set just before the memory monitor kills the process, so the
     # conn-closed path reports OutOfMemoryError rather than a crash
     oom_victim: bool = False
@@ -806,22 +810,43 @@ class NodeService:
         share one session dir, and K nodes each tailing it would print
         every line K times (and replay history on scale-up)."""
         offsets: Dict[str, int] = {}
+        labels: Dict[str, str] = {}
+        quiet_since: Dict[str, float] = {}
         while not self._stopped.wait(0.25):
-            paths = {w.log_path for w in list(self._workers.values())
-                     if w.log_path}
+            workers = list(self._workers.values())
+            live_paths = {w.log_path for w in workers if w.log_path}
+            for w in workers:
+                if w.log_path and w.log_label:
+                    labels[w.log_path] = w.log_label
             # keep tailing files we've seen: a worker's last lines often
-            # land right as it is reaped from self._workers
-            paths |= set(offsets)
+            # land right as it is reaped from self._workers — but prune
+            # a DEAD worker's path once its file has been quiet for a
+            # while (worker churn must not grow these dicts, or re-stat
+            # every dead replica's log forever)
+            paths = live_paths | set(offsets)
+            now_t = time.monotonic()
             for path in paths:
                 try:
                     size = os.path.getsize(path)
                     off = offsets.get(path, 0)
                     if size <= off:
+                        if path not in live_paths:
+                            first = quiet_since.setdefault(path, now_t)
+                            if now_t - first > 30.0:
+                                offsets.pop(path, None)
+                                labels.pop(path, None)
+                                quiet_since.pop(path, None)
                         continue
+                    quiet_since.pop(path, None)
                     with open(path, "rb") as f:
                         f.seek(off)
                         data = f.read(min(size - off, 1 << 20))
                 except OSError:
+                    # file gone: nothing left to drain for it
+                    if path not in live_paths:
+                        offsets.pop(path, None)
+                        labels.pop(path, None)
+                        quiet_since.pop(path, None)
                     continue
                 # consume only whole lines; a read landing mid-write
                 # leaves the partial tail for the next poll
@@ -837,6 +862,7 @@ class NodeService:
                         self.gcs.publish("LOG", {
                             "node_id": self.node_id.hex()[:12],
                             "worker": worker,
+                            "label": labels.get(path),
                             "lines": lines[i:i + 200],
                         })
                     except Exception:
@@ -1946,6 +1972,11 @@ class NodeService:
             self._on_return_leased(key, payload)
         elif op == P.NOTIFY_UNBLOCKED:
             self._worker_unblocked(key)
+        elif op == P.SET_LOG_LABEL:
+            wid = self._conn_worker.get(key)
+            w = self._workers.get(wid) if wid is not None else None
+            if w is not None:
+                w.log_label = str(payload)[:64]
         elif op == P.PROFILE_EVENT:
             ev_kind, ev_payload = payload
             if ev_kind == "spans":
@@ -1970,6 +2001,20 @@ class NodeService:
                         str(ev_payload.get("message",
                                            "collective group reformed")),
                         **fields)
+                except Exception:   # noqa: BLE001 — accounting only
+                    pass
+            elif ev_kind == "serve_request":
+                # a serve replica promoted a slow/failed request; the
+                # replica worker has no EventLogger, so the literal
+                # emit lives here (labels stay statically lintable)
+                try:
+                    rec = dict(ev_payload)
+                    req_kind = rec.pop("kind", "slow")
+                    msg = str(rec.pop("message", "serve request"))
+                    if req_kind == "error":
+                        self.events.warning("REQUEST_ERROR", msg, **rec)
+                    else:
+                        self.events.warning("SLOW_REQUEST", msg, **rec)
                 except Exception:   # noqa: BLE001 — accounting only
                     pass
         elif op == P.GET_OBJECTS:

@@ -187,6 +187,11 @@ ACTOR_CHECKPOINT = 81       # (req_id, ActorID, seq, blob) -> INFO_REPLY
 ACTOR_CHECKPOINT_GET = 82   # (req_id, ActorID) -> INFO_REPLY
                             # (seq, blob) | None — replayed into a
                             # restarted actor before queued calls drain
+SET_LOG_LABEL = 83          # worker -> node: label str — this worker's
+                            # log lines should carry a human name (e.g.
+                            # a serve replica's "deployment#tag") in
+                            # the driver's "(worker ...)" prefix
+                            # instead of a bare worker id
 
 # Generic coalesced frame: (BATCH, [(op, payload), ...]). Produced by
 # the Connection writer when several messages are pending at flush time
@@ -228,7 +233,7 @@ def _mk_task_spec(t: tuple) -> "TaskSpec":
      rids, s.resources, s.max_retries, s.retry_exceptions, aid,
      s.method_name, s.seq_no, s.scheduling_strategy, s.owner_id,
      s.origin_node_id, s.namespace, s.runtime_env, s.trace_context,
-     s.accel_ids) = t
+     s.accel_ids, s.request_ctx) = t
     s.task_id = TaskID(tid)
     s.job_id = JobID(jid)
     s.return_ids = [ObjectID(b) for b in rids]
@@ -276,6 +281,10 @@ class TaskSpec:
     # dispatch (reference: resource-instance ids / GPU id assignment);
     # read via get_runtime_context().get_accelerator_ids()
     accel_ids: Optional[List[int]] = None
+    # request-scoped baggage (serve request ids; reference analogue:
+    # W3C baggage): submitter's context.request_ctx tuple, re-bound by
+    # the executing worker so the request's whole call tree carries it
+    request_ctx: Optional[tuple] = None
 
     def __reduce__(self):
         # Hot-path serialization: a task spec crosses the wire 2-3 times
@@ -292,7 +301,8 @@ class TaskSpec:
              self.actor_id.binary() if self.actor_id else None,
              self.method_name, self.seq_no, self.scheduling_strategy,
              self.owner_id, self.origin_node_id, self.namespace,
-             self.runtime_env, self.trace_context, self.accel_ids),))
+             self.runtime_env, self.trace_context, self.accel_ids,
+             self.request_ctx),))
 
 
 @dataclass

@@ -45,6 +45,183 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 
 _N_SHARDS = 8
 
+# ------------------------------------------------------- quantile digest
+# Fixed-memory streaming quantile sketch (small merging t-digest): a
+# sorted list of (mean, weight) centroids capped at _DIGEST_CENTROIDS,
+# with raw observations staged in a short buffer and folded in by a
+# single merge pass whose per-centroid weight limit follows the t-digest
+# k1 scale (4·total·q·(1-q)/K) — tails stay near-singleton, the middle
+# coarsens, so p50/p95/p99 stay accurate without retaining samples.
+# Digests ship through the same delta flusher as histograms: the record
+# path keeps a cumulative digest (local snapshots) AND a since-last-
+# flush digest (the shipped delta); the control plane merges deltas by
+# centroid concatenation + the same compress pass, which is exactly the
+# t-digest merge operation — so per-process sketches combine into one
+# cluster-wide per-series quantile view.
+
+_DIGEST_CENTROIDS = 64
+_DIGEST_BUF = 32
+
+
+def _digest_merge_pass(items: List[list], k: int) -> List[list]:
+    """One merging pass over sorted (mean, weight) pairs: cluster
+    weights bounded by the t-digest k1 scale 4·total·q·(1-q)/k, so the
+    middle coarsens while the tails stay near-singleton."""
+    total = sum(c[1] for c in items)
+    out: List[list] = []
+    cum = 0.0
+    cur_mean, cur_w = items[0]
+    for mean, w in items[1:]:
+        q = (cum + cur_w / 2.0) / total
+        limit = max(1.0, 4.0 * total * q * (1.0 - q) / k)
+        if cur_w + w <= limit:
+            cur_mean += (mean - cur_mean) * (w / (cur_w + w))
+            cur_w += w
+        else:
+            out.append([cur_mean, cur_w])
+            cum += cur_w
+            cur_mean, cur_w = mean, w
+    out.append([cur_mean, cur_w])
+    return out
+
+
+def _digest_compress(items: List[list], k: int) -> List[list]:
+    """Compress (mean, weight) pairs to at most ~2k centroids. The k1
+    pass alone converges to O(k·ln n) clusters (the weight limit keeps
+    shrinking toward the tails), so re-run it with a halved k until the
+    hard cap holds — memory stays FIXED regardless of stream length."""
+    if not items:
+        return []
+    items.sort(key=lambda c: c[0])
+    out = _digest_merge_pass(items, k)
+    kk = k
+    while len(out) > 2 * k and kk > 1:
+        kk //= 2
+        out = _digest_merge_pass(out, kk)
+    return out
+
+
+class _Digest:
+    """One digest series: compressed centroids + a small staging buffer
+    (bounded memory; no sample retention beyond the buffer)."""
+
+    __slots__ = ("cents", "buf", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.cents: List[list] = []
+        self.buf: List[float] = []
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, value: float) -> None:
+        self.buf.append(value)
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        if len(self.buf) >= _DIGEST_BUF:
+            self._fold()
+
+    def add_many(self, values: List[float], lazy: bool = False) -> None:
+        """Bulk fold: ONE compress pass for the whole batch (the
+        record path stages raw values and drains them here at flush
+        cadence — per-observation cost stays an append). ``lazy``
+        defers even that compress by parking the batch in the staging
+        buffer until it hits ~_DIGEST_STAGE — the cumulative digest
+        (read only by local snapshots) folds on a much coarser cadence
+        than the per-flush delta, halving the flush-path cost."""
+        if not values:
+            return
+        self.count += len(values)
+        self.sum += sum(values)
+        mn, mx = min(values), max(values)
+        if mn < self.min:
+            self.min = mn
+        if mx > self.max:
+            self.max = mx
+        if lazy:
+            self.buf.extend(values)
+            if len(self.buf) >= _DIGEST_STAGE:
+                self._fold()
+            return
+        self.cents = _digest_compress(
+            self.cents + [[v, 1.0] for v in self.buf]
+            + [[v, 1.0] for v in values], _DIGEST_CENTROIDS)
+        self.buf = []
+
+    def _fold(self) -> None:
+        if self.buf:
+            self.cents = _digest_compress(
+                self.cents + [[v, 1.0] for v in self.buf],
+                _DIGEST_CENTROIDS)
+            self.buf = []
+
+    def merge_payload(self, payload: dict) -> None:
+        if not payload or not payload.get("count"):
+            return
+        self._fold()
+        self.cents = _digest_compress(
+            self.cents + [list(c) for c in payload.get("centroids") or ()],
+            _DIGEST_CENTROIDS)
+        self.count += int(payload["count"])
+        self.sum += float(payload.get("sum", 0.0))
+        self.min = min(self.min, float(payload.get("min", self.min)))
+        self.max = max(self.max, float(payload.get("max", self.max)))
+
+    def to_payload(self) -> dict:
+        self._fold()
+        return {"centroids": [list(c) for c in self.cents],
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max}
+
+
+def merge_digest_payloads(cur: Optional[dict], new: dict) -> dict:
+    """Merge two shipped digest payloads (the control-plane fold)."""
+    if not cur or not cur.get("count"):
+        return {"centroids": [list(c) for c in new.get("centroids") or ()],
+                "count": int(new.get("count", 0)),
+                "sum": float(new.get("sum", 0.0)),
+                "min": float(new.get("min", float("inf"))),
+                "max": float(new.get("max", float("-inf")))}
+    if not new.get("count"):
+        return cur
+    d = _Digest()
+    d.merge_payload(cur)
+    d.merge_payload(new)
+    return d.to_payload()
+
+
+def digest_quantile(payload: Optional[dict], q: float) -> float:
+    """Estimate quantile ``q`` (0..1) from a shipped digest payload
+    (midpoint interpolation between centroid means, clamped to the
+    exact observed min/max)."""
+    if not payload or not payload.get("count"):
+        return 0.0
+    cents = sorted((list(c) for c in payload.get("centroids") or ()),
+                   key=lambda c: c[0])
+    lo = float(payload.get("min", cents[0][0] if cents else 0.0))
+    hi = float(payload.get("max", cents[-1][0] if cents else 0.0))
+    if not cents:
+        return lo
+    total = sum(c[1] for c in cents)
+    target = q * total
+    cum = 0.0
+    prev_mean, prev_mid = lo, 0.0
+    for mean, w in cents:
+        mid = cum + w / 2.0
+        if target <= mid:
+            if mid == prev_mid:
+                return max(lo, min(hi, mean))
+            frac = (target - prev_mid) / (mid - prev_mid)
+            return max(lo, min(hi, prev_mean + (mean - prev_mean) * frac))
+        prev_mean, prev_mid = mean, mid
+        cum += w
+    return hi
+
 
 class _Hist:
     __slots__ = ("buckets", "counts", "sum", "count", "exemplar",
@@ -68,6 +245,13 @@ class _Shard:
         self.gauges: Dict[tuple, tuple] = {}        # key -> (value, ts)
         self.gauges_dirty: set = set()              # keys set since flush
         self.hists: Dict[tuple, _Hist] = {}
+        # key -> [cumulative _Digest, since-last-flush _Digest, raw
+        # staging buffer]. The record path ONLY appends to the staging
+        # buffer; values drain into both digests (one bulk compress
+        # each) at flush/snapshot time, or when the buffer hits
+        # _DIGEST_STAGE cap under a burst — so the per-observation cost
+        # is a list append, like counters.
+        self.digests: Dict[tuple, list] = {}
 
 
 _shards = [_Shard() for _ in range(_N_SHARDS)]
@@ -92,6 +276,11 @@ _jax_listener_installed = False
 
 def _shard(key: tuple) -> _Shard:
     return _shards[hash(key) & (_N_SHARDS - 1)]
+
+
+# bumped by reset(): pinned digest_series handles re-resolve into the
+# fresh shard tables instead of writing to orphaned entries
+_digest_gen = 0
 
 
 def define(kind: str, name: str, description: str = "",
@@ -154,6 +343,18 @@ def gauge_set(name: str, value: float, tags: tuple = ()) -> None:
         sh.gauges_dirty.add(key)
 
 
+def gauge_delete(name: str, tags: tuple = ()) -> None:
+    """Retire one gauge SERIES cluster-wide: ships a NaN marker through
+    the normal delta flush; the control plane (and the local snapshot)
+    drop the series instead of exporting the marker. For series whose
+    identity dies with its subject — a stopped serve replica's queue
+    depth must not read as a live value (or a sentinel) forever on
+    Prometheus/dashboard/summary surfaces. Best-effort under races: a
+    straggling publish from the dying process can resurrect the series
+    until its next delete."""
+    gauge_set(name, float("nan"), tags)
+
+
 def hist_observe(name: str, value: float, tags: tuple = (),
                  boundaries: Optional[Tuple[float, ...]] = None) -> None:
     if not CONFIG.telemetry_enabled:
@@ -185,15 +386,102 @@ def hist_observe(name: str, value: float, tags: tuple = (),
             h.exemplar = exemplar
 
 
+_DIGEST_STAGE = 512
+
+
+def _drain_digest(ent: list) -> None:
+    """Fold a series' staged raw values into both its cumulative and
+    its since-last-flush digest (caller holds the shard lock). The
+    cumulative side folds LAZILY — it is only read by local snapshots,
+    so the per-flush compress cost is one pass (the shipped delta),
+    not two."""
+    if ent[2]:
+        ent[0].add_many(ent[2], lazy=True)
+        ent[1].add_many(ent[2])
+        ent[2] = []
+
+
+def digest_observe(name: str, value: float, tags: tuple = ()) -> None:
+    """Record one observation into a streaming quantile digest (fixed
+    memory, same sharded no-RPC record path as histograms; the delta
+    flusher ships centroids and the plane t-digest-merges them). The
+    record path is a list append — compression runs at flush cadence
+    (or at the staging cap under a burst), never per observation."""
+    if not CONFIG.telemetry_enabled:
+        return
+    if not _flusher_started:
+        _ensure_flusher()
+    _digest_record((name, tags), float(value))
+
+
+def digest_series(name: str, tags: tuple = ()):
+    """Prebind one digest series for per-call-site hot paths (serve
+    replicas record two digests per request): returns a mutable handle
+    for ``digest_record`` that caches the resolved shard + entry, so
+    the per-observation cost is one lock + one list append — no key
+    hash, no dict lookup. Handles survive ``reset()`` via a generation
+    check (the next record re-resolves into the fresh shard tables)."""
+    return [(name, tags), None, None, -1]
+
+
+def digest_record(series, value: float) -> None:
+    """Record into a ``digest_series`` handle (hot-path variant of
+    ``digest_observe`` — same semantics, fewer per-observation costs)."""
+    # direct _values read: __getattr__ dispatch costs ~0.4µs/read and
+    # this runs twice per serve request
+    if not CONFIG._values["telemetry_enabled"]:
+        return
+    if not _flusher_started:
+        _ensure_flusher()
+    sh = series[1]
+    if series[3] != _digest_gen:
+        key = series[0]
+        sh = _shard(key)
+        with sh.lock:
+            ent = sh.digests.get(key)
+            if ent is None:
+                ent = [_Digest(), _Digest(), []]
+                sh.digests[key] = ent
+        series[1], series[2], series[3] = sh, ent, _digest_gen
+    ent = series[2]
+    with sh.lock:
+        ent[2].append(float(value))
+        if len(ent[2]) >= _DIGEST_STAGE:
+            _drain_digest(ent)
+
+
+def _digest_record(key: tuple, value: float) -> None:
+    sh = _shard(key)
+    with sh.lock:
+        ent = sh.digests.get(key)
+        if ent is None:
+            ent = sh.digests[key] = [_Digest(), _Digest(), []]
+        ent[2].append(value)
+        if len(ent[2]) >= _DIGEST_STAGE:
+            _drain_digest(ent)
+
+
 # --------------------------------------------------------------- flushing
+
+_last_digest_ship = 0.0
+_DIGEST_SHIP_INTERVAL_S = 1.0
+
 
 def _collect_deltas() -> Optional[dict]:
     """Per-shard deltas since the last collect; None when nothing moved.
     Advances the flushed watermark, so call only with a transport in
-    hand."""
+    hand. Digest deltas ship on their own coarser cadence (~1s):
+    counters/gauges are cheap to ship per flush, but a digest delta
+    costs a compress pass here AND a merge pass on the plane — at the
+    0.2s task-boundary flush rate that CPU competes with the serving
+    path itself on small boxes, for freshness nothing consumes."""
+    global _last_digest_ship
     counters: Dict[tuple, float] = {}
     gauges: Dict[tuple, tuple] = {}
     hists: Dict[tuple, dict] = {}
+    digests: Dict[tuple, dict] = {}
+    now = time.monotonic()
+    ship_digests = now - _last_digest_ship >= _DIGEST_SHIP_INTERVAL_S
     for sh in _shards:
         with sh.lock:
             for key, ent in sh.counters.items():
@@ -204,6 +492,10 @@ def _collect_deltas() -> Optional[dict]:
             for key in sh.gauges_dirty:
                 if key in sh.gauges:
                     gauges[key] = sh.gauges[key]
+                    if sh.gauges[key][0] != sh.gauges[key][0]:
+                        # NaN delete marker: ship it once, then drop
+                        # the local series too
+                        del sh.gauges[key]
             sh.gauges_dirty.clear()
             for key, h in sh.hists.items():
                 dc = [a - b for a, b in zip(h.counts, h.f_counts)]
@@ -216,12 +508,20 @@ def _collect_deltas() -> Optional[dict]:
                     h.f_sum = h.sum
                     h.f_count = h.count
                     h.exemplar = None
-    if not (counters or gauges or hists):
+            if ship_digests:
+                for key, dent in sh.digests.items():
+                    _drain_digest(dent)
+                    if dent[1].count:
+                        digests[key] = dent[1].to_payload()
+                        dent[1] = _Digest()
+    if digests:
+        _last_digest_ship = now
+    if not (counters or gauges or hists or digests):
         return None
     with _meta_lock:
         meta = {name: dict(m) for name, m in _meta.items()}
     return {"counters": counters, "gauges": gauges, "hists": hists,
-            "meta": meta}
+            "digests": digests, "meta": meta}
 
 
 def _transport():
@@ -250,10 +550,16 @@ def _restore_deltas(payload: dict) -> None:
             ent = sh.counters.get(key)
             if ent is not None:
                 ent[1] -= d
-    for key in payload.get("gauges", {}):
+    for key, vt in payload.get("gauges", {}).items():
         sh = _shard(key)
         with sh.lock:
             if key in sh.gauges:
+                sh.gauges_dirty.add(key)
+            elif vt[0] != vt[0]:
+                # a NaN delete marker was dropped at collect time; the
+                # failed send must re-queue it or the plane never
+                # forgets the series
+                sh.gauges[key] = tuple(vt)
                 sh.gauges_dirty.add(key)
     for key, hd in payload.get("hists", {}).items():
         sh = _shard(key)
@@ -266,6 +572,14 @@ def _restore_deltas(payload: dict) -> None:
             h.f_count -= hd["count"]
             if h.exemplar is None:
                 h.exemplar = hd.get("exemplar")
+    for key, dd in payload.get("digests", {}).items():
+        sh = _shard(key)
+        with sh.lock:
+            ent = sh.digests.get(key)
+            if ent is None:
+                ent = sh.digests[key] = [_Digest(), _Digest(), []]
+                ent[0].merge_payload(dd)
+            ent[1].merge_payload(dd)
 
 
 def flush() -> None:
@@ -325,31 +639,39 @@ def snapshot_local() -> dict:
     counters: Dict[tuple, float] = {}
     gauges: Dict[tuple, tuple] = {}
     hists: Dict[tuple, dict] = {}
+    digests: Dict[tuple, dict] = {}
     for sh in _shards:
         with sh.lock:
             for key, ent in sh.counters.items():
                 counters[key] = counters.get(key, 0.0) + ent[0]
-            gauges.update(sh.gauges)
+            gauges.update((k, v) for k, v in sh.gauges.items()
+                          if v[0] == v[0])    # skip NaN delete markers
             for key, h in sh.hists.items():
                 hists[key] = {"buckets": h.buckets,
                               "counts": list(h.counts),
                               "sum": h.sum, "count": h.count,
                               "exemplar": h.exemplar}
+            for key, dent in sh.digests.items():
+                _drain_digest(dent)
+                digests[key] = dent[0].to_payload()
     with _meta_lock:
         meta = {name: dict(m) for name, m in _meta.items()}
     return {"counters": counters, "gauges": gauges, "hists": hists,
-            "meta": meta}
+            "digests": digests, "meta": meta}
 
 
 def reset() -> None:
     """Drop all local series and node registrations (session teardown:
     the next init() must not inherit this session's samples)."""
+    global _digest_gen
+    _digest_gen += 1
     for sh in _shards:
         with sh.lock:
             sh.counters.clear()
             sh.gauges.clear()
             sh.gauges_dirty.clear()
             sh.hists.clear()
+            sh.digests.clear()
     with _runtime_lock:
         _nodes.clear()
 

@@ -95,6 +95,7 @@ class WorkerRuntime:
         # last capture (the periodic trigger)
         self._ckpt_counter = itertools.count(1)
         self._ckpt_calls = 0
+        self._ckpt_last_t = time.monotonic()
 
     # ------------------------------------------------------------ main loop
     def run(self) -> None:
@@ -174,6 +175,10 @@ class WorkerRuntime:
 
     def _enqueue_execute(self, payload) -> None:
         kind, spec, deps = payload[0], payload[1], payload[2]
+        if kind == "actor_call" and spec.request_ctx is not None:
+            # arrival stamp for the request's skew-free local queue
+            # wait (in-process attribute — never serialized)
+            spec._rtpu_recv_t = time.monotonic()
         if kind == "actor_call" and (
                 self._pool is not None or self._aio_loop is not None):
             self._dispatch_concurrent(spec, deps)
@@ -256,6 +261,13 @@ class WorkerRuntime:
         # concurrent calls on a threaded actor don't race each other)
         context.current_namespace.set(
             actor_spec.namespace if actor_spec else spec.namespace)
+        # request-scoped baggage: re-bound for the call's duration so
+        # the request's nested submissions carry it onward and a serve
+        # replica reads its request context without paying an arg slot
+        req_token = context.request_ctx.set(spec.request_ctx)
+        recv_token = (context.request_recv_t.set(
+            getattr(spec, "_rtpu_recv_t", None))
+            if spec.request_ctx is not None else None)
         span_cm = self._task_span(kind, spec)
         try:
             with span_cm:
@@ -286,6 +298,9 @@ class WorkerRuntime:
         except BaseException as e:  # noqa: BLE001
             self._send_done(spec, kind, None, e)
         finally:
+            context.request_ctx.reset(req_token)
+            if recv_token is not None:
+                context.request_recv_t.reset(recv_token)
             context.current_task_id = None
             context.current_task_name = None
             context.current_accel_ids = None   # slot may be recycled next
@@ -317,6 +332,7 @@ class WorkerRuntime:
 
     async def _run_async(self, spec: P.TaskSpec, deps) -> None:
         context.current_namespace.set(spec.namespace)
+        req_token = context.request_ctx.set(spec.request_ctx)
         # actor-wide slots: identical for every call of this actor, so
         # the module-global is safe under asyncio interleaving
         context.current_accel_ids = spec.accel_ids
@@ -341,6 +357,7 @@ class WorkerRuntime:
             tracing.end_span(span, error=type(e).__name__)
             self._send_done(spec, "actor_call", None, e)
         finally:
+            context.request_ctx.reset(req_token)
             # best-effort under interleaving (another call's name may be
             # re-set right after) — but a stale name on an IDLE worker
             # would misattribute every filtered profile sample forever
@@ -361,6 +378,12 @@ class WorkerRuntime:
             self._pool = ThreadPoolExecutor(
                 max_workers=actor_spec.max_concurrency)
         self._actor_instance = cls(*args, **kwargs)
+        label = getattr(self._actor_instance, "__rtpu_log_label__", None)
+        if label:
+            # this process's log lines get a human name in the driver's
+            # "(worker ...)" prefix (serve replicas set their
+            # deployment#tag, so `rtpu logs` greps by deployment)
+            self.conn.send((P.SET_LOG_LABEL, str(label)[:64]))
         self._restore_checkpoint(actor_spec)
         context.actor_checkpoint_hook = self.checkpoint_now
         return None
@@ -406,8 +429,18 @@ class WorkerRuntime:
             # actors checkpoint on demand at points THEY know are safe
             return
         every = CONFIG.actor_checkpoint_interval_calls
+        every_s = CONFIG.actor_checkpoint_interval_s
         self._ckpt_calls += 1
-        if every > 0 and self._ckpt_calls >= every:
+        # TIME trigger beside the call-count one, checked at the same
+        # quiescent point (a call just completed — for sync actors the
+        # only moment a snapshot is guaranteed consistent; an IDLE actor
+        # mutates no state, so there is nothing new to capture between
+        # calls): a slow-call actor whose calls each outlast the
+        # interval checkpoints once per call even when the call-count
+        # trigger would never fire
+        if (every > 0 and self._ckpt_calls >= every) or \
+                (every_s > 0
+                 and time.monotonic() - self._ckpt_last_t >= every_s):
             self.checkpoint_now()
 
     def checkpoint_now(self) -> int:
@@ -442,6 +475,7 @@ class WorkerRuntime:
             # is there (benign if a concurrent caller re-seeds too)
             self._ckpt_counter = itertools.count(seq + 1)
         self._ckpt_calls = 0
+        self._ckpt_last_t = time.monotonic()
         telemetry.counter_inc(M_ACTOR_CKPTS)
         return seq
 

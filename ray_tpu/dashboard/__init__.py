@@ -195,6 +195,9 @@ async function refreshMetrics() {
       value: r.kind === "histogram"
         ? `count=${r.count} mean=${r.count
             ? (r.sum / r.count).toFixed(4) : "-"}`
+        : r.kind === "digest"
+        ? `count=${r.count} p50=${(r.quantiles||{}).p50?.toFixed(4)
+            } p99=${(r.quantiles||{}).p99?.toFixed(4)}`
         : r.value,
     }));
     fill("metrics", rows, ["name", "kind", "tags", "value"]);
@@ -289,6 +292,14 @@ class _Handler(JsonHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return None
+            if path == "/api/serve":
+                # serving health plane: per-deployment latency/queue
+                # percentiles (streaming digests), queue depth, error
+                # rate and the replica table — shaped from the head's
+                # merged metrics table (no client needed)
+                return self._json(200, {
+                    "serve": state_api.shape_serve_health(
+                        node._state_query("metrics", None))})
             if path == "/api/stacks":
                 # on-demand cluster thread dump (the `rtpu stack`
                 # surface); handler threads may block for the fan-out

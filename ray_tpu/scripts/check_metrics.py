@@ -151,10 +151,11 @@ def readme_registry_label_cells(readme_path: str) -> List[Tuple[str, str]]:
 def collect_used_tag_keys(pkg_dir: str,
                           files=None) -> Dict[str, Dict[str, str]]:
     """Metric name -> {tag key -> file} for every literal ``tags=(("k",
-    v), ...)`` passed to ``counter_inc``/``gauge_set``/``hist_observe``
-    whose metric argument is a name bound by ``X = telemetry.define(
-    kind, "rtpu_...", ...)``. Dynamic tag expressions are skipped — the
-    lint only judges what it can read statically."""
+    v), ...)`` passed to ``counter_inc``/``gauge_set``/``hist_observe``/
+    ``digest_observe``/``digest_series`` whose metric argument is a name
+    bound by ``X = telemetry.define(kind, "rtpu_...", ...)``. Dynamic
+    tag expressions are skipped — the lint only judges what it can read
+    statically."""
     files = list(files if files is not None else _walk_files(pkg_dir))
     # pass 1: variable name -> metric name (module-scope define binds)
     var_to_metric: Dict[str, str] = {}
@@ -182,7 +183,8 @@ def collect_used_tag_keys(pkg_dir: str,
             fn = node.func
             fname = (fn.attr if isinstance(fn, ast.Attribute)
                      else fn.id if isinstance(fn, ast.Name) else None)
-            if fname not in ("counter_inc", "gauge_set", "hist_observe"):
+            if fname not in ("counter_inc", "gauge_set", "hist_observe",
+                             "digest_observe", "digest_series"):
                 continue
             metric_arg = node.args[0]
             var = (metric_arg.attr if isinstance(metric_arg, ast.Attribute)
@@ -191,9 +193,13 @@ def collect_used_tag_keys(pkg_dir: str,
             metric = var_to_metric.get(var or "")
             if metric is None:
                 continue
+            # digest_series prebinds (metric, tags) — the hot-path
+            # digest_record sites carry no tags of their own, so the
+            # prebind is where those series' keys are declared
+            tag_pos = 1 if fname == "digest_series" else 2
             tags_node = None
-            if len(node.args) >= 3:
-                tags_node = node.args[2]
+            if len(node.args) > tag_pos:
+                tags_node = node.args[tag_pos]
             for kw in node.keywords:
                 if kw.arg == "tags":
                     tags_node = kw.value
@@ -320,12 +326,19 @@ def check(repo_root: str = None) -> List[str]:
             f"{name}: listed in the README registry but no "
             "telemetry.define() in ray_tpu/ registers it")
     # type column of the registry table must match the define() kind
-    # (a histogram documented as a counter misleads every dashboard)
+    # (a histogram documented as a counter misleads every dashboard),
+    # and the kind itself must be one the telemetry core implements —
+    # a typo'd kind would otherwise record nothing, silently
     kinds = collect_defined_metric_kinds(os.path.join(root, "ray_tpu"),
                                          files)
     rows = readme_registry_rows(os.path.join(root, "README.md"))
     row_types = dict(rows)
+    valid_kinds = ("counter", "gauge", "histogram", "digest")
     for name, (kind, where) in sorted(kinds.items()):
+        if kind not in valid_kinds:
+            problems.append(
+                f"{name} ({where}): defined with unknown kind "
+                f"{kind!r} (valid: {', '.join(valid_kinds)})")
         doc_type = row_types.get(name)
         if doc_type is not None and doc_type != kind:
             problems.append(

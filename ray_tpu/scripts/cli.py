@@ -307,6 +307,70 @@ def cmd_coll_debug(client, args) -> None:
                           f"{ev.get('key')} ({ev.get('info')})")
 
 
+def cmd_serve_status(client, args) -> None:
+    """Serving health plane: per-deployment latency/queue-wait
+    percentiles (streaming digests), queue depth, error rate, replica
+    table — the autoscaling signal tuple."""
+    from ..state import serve_health
+    health = serve_health()
+    if args.format == "json":
+        print(json.dumps(health, default=str, indent=2))
+        return
+    deps = health.get("deployments") or {}
+    if not deps:
+        print("no serve deployments observed")
+        return
+
+    def ms(d, q):
+        v = (d or {}).get(q)
+        return f"{v * 1000:.1f}ms" if v is not None else "-"
+
+    rows = []
+    for name in sorted(deps):
+        d = deps[name]
+        rows.append({
+            "deployment": name,
+            "replicas": len(d.get("replicas") or []),
+            "queue": f"{d.get('queue_depth', 0):g}",
+            "reqs": f"{d.get('requests_total', 0):g}",
+            "err_rate": f"{d.get('error_rate', 0.0):.1%}",
+            "p50": ms(d.get("latency"), "p50"),
+            "p95": ms(d.get("latency"), "p95"),
+            "p99": ms(d.get("latency"), "p99"),
+            "qwait_p99": ms(d.get("queue_wait"), "p99"),
+            "batch_p50": (f"{(d.get('batch_size') or {}).get('p50', 0):.1f}"
+                          if d.get("batch_size") else "-"),
+        })
+    _print_table(rows, ["deployment", "replicas", "queue", "reqs",
+                        "err_rate", "p50", "p95", "p99", "qwait_p99",
+                        "batch_p50"])
+    if health.get("worst"):
+        print(f"\nworst deployment: {health['worst']}")
+
+
+def cmd_requests(client, args) -> None:
+    """Recent serve access-log rows gathered from every replica's ring
+    (request_id, deployment, route, status, latency, queue wait)."""
+    from ..state import serve_requests
+    rows = serve_requests(limit=args.limit, slow=args.slow,
+                          errors=args.errors)
+    if args.format == "json":
+        print(json.dumps(rows, default=str, indent=2))
+        return
+    if not rows:
+        print("no request rows (serve idle, or request_log_capacity=0)")
+        return
+    _print_table(
+        [{**r,
+          "latency": f"{r.get('latency_s', 0) * 1000:.1f}ms",
+          "queue_wait": f"{r.get('queue_wait_s', 0) * 1000:.1f}ms",
+          "batch": r.get("batch_size") or "-",
+          "error": (str(r.get("error"))[:40] if r.get("error") else "")}
+         for r in rows],
+        ["request_id", "deployment", "replica", "route", "proto",
+         "status", "latency", "queue_wait", "batch", "error"])
+
+
 def cmd_doctor(client, args) -> None:
     """Correlated cluster health report: nodes, resources, task/actor
     rollups, stall diagnoses, recent alerts, telemetry highlights."""
@@ -333,6 +397,15 @@ def cmd_doctor(client, args) -> None:
         print(f"  STALL [{ev.get('cause')}] {ev.get('message')}")
     for v in (rep.get("collectives") or {}).get("verdicts", []):
         print(f"  COLLECTIVE [{v.get('verdict')}] {v.get('message')}")
+    srv = rep.get("serve") or {}
+    if srv.get("deployments"):
+        worst = srv.get("worst")
+        wd = (srv["deployments"].get(worst) or {}) if worst else {}
+        lat = wd.get("latency") or {}
+        print(f"serve: {len(srv['deployments'])} deployment(s); "
+              f"worst: {worst} "
+              f"(err_rate={wd.get('error_rate', 0.0):.1%}, "
+              f"p99={(lat.get('p99') or 0.0) * 1000:.1f}ms)")
     rec = rep.get("recovery") or {}
     if any((rec.get("collective_reforms"), rec.get("actor_restores"),
             rec.get("actor_checkpoints"),
@@ -555,6 +628,24 @@ def main(argv=None) -> None:
     p_coll.add_argument("--format", choices=("text", "json"),
                         default="text")
 
+    p_srv = sub.add_parser("serve-status",
+                           help="per-deployment serving health: "
+                           "latency/queue percentiles, error rate, "
+                           "replica table")
+    p_srv.add_argument("--format", choices=("table", "json"),
+                       default="table")
+    p_req = sub.add_parser("requests",
+                           help="recent serve access-log rows "
+                           "(request ids, latency, queue wait)")
+    p_req.add_argument("--slow", action="store_true",
+                       help="only rows at/over the slow-request "
+                       "threshold")
+    p_req.add_argument("--errors", action="store_true",
+                       help="only failed requests")
+    p_req.add_argument("--limit", type=int, default=50)
+    p_req.add_argument("--format", choices=("table", "json"),
+                       default="table")
+
     p_start = sub.add_parser("start", help="start a cluster node process")
     p_start.add_argument("--head", action="store_true")
     p_start.add_argument("--address", default=None)
@@ -623,7 +714,9 @@ def main(argv=None) -> None:
          "memory": cmd_memory, "timeline": cmd_timeline,
          "metrics": cmd_metrics, "stack": cmd_stack,
          "profile": cmd_profile, "doctor": cmd_doctor,
-         "coll-debug": cmd_coll_debug}[args.command](
+         "coll-debug": cmd_coll_debug,
+         "serve-status": cmd_serve_status,
+         "requests": cmd_requests}[args.command](
              client, args)
     finally:
         try:

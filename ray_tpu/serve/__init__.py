@@ -31,3 +31,4 @@ from .multiplex import (  # noqa: F401
     multiplexed,
 )
 from .proxy import proxy_addresses  # noqa: F401
+from .request_context import get_request_id  # noqa: F401

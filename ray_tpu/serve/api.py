@@ -9,6 +9,7 @@ identical for HTTP and Python callers.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 from typing import Any, Callable, Dict, Optional
@@ -161,19 +162,62 @@ class _GatewayHandler:
             self._handles[name] = handle
         return handle
 
-    def call(self, name: str, arg, model_id: Optional[str] = None):
+    @contextlib.contextmanager
+    def _ingress(self, name: str, request_id: Optional[str],
+                 proto: str, stream: bool):
+        """One request's ingress scope: mint/adopt the request id, bind
+        the request context the handle ships to the replica, and open
+        the force-traced ``request::ingress`` span — so the whole
+        request is one trace even when ``tracing_enabled`` is off. The
+        span covers whatever runs inside the scope (unary: routing +
+        result wait; streaming: routing/submission only — stream
+        latency is recorded replica-side at exhaustion). Span shipping
+        is rate-limited; the timeline/list_spans readers flush the
+        tail themselves."""
+        from . import request_context as _rc
+        from ..util import tracing
+        meta = _rc.make(name, request_id=request_id, proto=proto)
+        if stream:
+            meta["stream"] = True
+        attributes = {"request_id": meta["request_id"],
+                      "deployment": name, "route": meta["route"],
+                      "proto": proto}
+        if stream:
+            attributes["stream"] = True
+        token = _rc.bind(meta)
+        try:
+            with tracing.start_span("request::" + "ingress",
+                                    attributes=attributes, force=True):
+                yield
+        finally:
+            _rc.unbind(token)
+            tracing.maybe_flush()
+
+    def call(self, name: str, arg, model_id: Optional[str] = None,
+             request_id: Optional[str] = None, proto: str = "http"):
+        """One unary request through the gateway (caller-supplied
+        ``X-Request-ID`` honored via ``request_id``)."""
+        from . import request_context as _rc
         handle = self._handle(name)
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
-        return handle.remote(arg).result(timeout=30.0)
+        if not _rc.enabled():
+            return handle.remote(arg).result(timeout=30.0)
+        with self._ingress(name, request_id, proto, stream=False):
+            return handle.remote(arg).result(timeout=30.0)
 
-    def stream(self, name: str, arg, model_id: Optional[str] = None):
+    def stream(self, name: str, arg, model_id: Optional[str] = None,
+               request_id: Optional[str] = None, proto: str = "http"):
         """Iterator of item values from a streaming deployment handler
         (generator)."""
+        from . import request_context as _rc
         handle = self._handle(name)
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
-        return handle.stream(arg)
+        if not _rc.enabled():
+            return handle.stream(arg)
+        with self._ingress(name, request_id, proto, stream=True):
+            return handle.stream(arg)
 
 
 def _gateway_server(host: str = "127.0.0.1", port: int = 0):
@@ -187,14 +231,25 @@ def _gateway_server(host: str = "127.0.0.1", port: int = 0):
 
     class Handler(JsonHandler):
         def _dispatch(self, arg_from_body: bool):
+            from . import request_context as _rc
             path, _, query = self.path.partition("?")
             name = path.strip("/").split("/")[0]
+            # inbound X-Request-ID is honored (distributed callers
+            # stitch their own ids through); otherwise minted here —
+            # either way the response echoes it in X-RTPU-Request-ID
+            rid = None
+            rid_headers = {}
+            if _rc.enabled():
+                rid = (self.headers.get("X-Request-ID")
+                       or _rc.new_request_id())
+                rid_headers = {"X-RTPU-Request-ID": rid}
             try:
                 if path.rstrip("/") == "/-/routes":
                     return self._json(200, gateway.routes())
                 if not name or f"/{name}" not in gateway.routes():
                     return self._json(404,
-                                      {"error": f"no deployment {name!r}"})
+                                      {"error": f"no deployment {name!r}"},
+                                      headers=rid_headers)
                 if arg_from_body:
                     # an EMPTY body means "no argument" (None), matching
                     # the GET-without-query semantics below
@@ -216,7 +271,8 @@ def _gateway_server(host: str = "127.0.0.1", port: int = 0):
                     # an immediately-failing handler gets a real 500;
                     # later errors become a terminal {"error": ...}
                     # line (headers are already on the wire by then).
-                    stream_it = iter(gateway.stream(name, arg))
+                    stream_it = iter(gateway.stream(name, arg,
+                                                    request_id=rid))
                     first = _STREAM_END = object()
                     try:
                         first = next(stream_it)
@@ -226,6 +282,8 @@ def _gateway_server(host: str = "127.0.0.1", port: int = 0):
                     self.send_header("Content-Type",
                                      "application/x-ndjson")
                     self.send_header("Connection", "close")
+                    for key, value in rid_headers.items():
+                        self.send_header(key, value)
                     self.end_headers()
 
                     def write_line(obj) -> None:
@@ -241,10 +299,12 @@ def _gateway_server(host: str = "127.0.0.1", port: int = 0):
                     except Exception as e:  # noqa: BLE001 — terminal line
                         write_line({"error": str(e)})
                     return None
-                result = gateway.call(name, arg)
-                return self._json(200, {"result": result})
+                result = gateway.call(name, arg, request_id=rid)
+                return self._json(200, {"result": result},
+                                  headers=rid_headers)
             except Exception as e:   # noqa: BLE001 — always answer JSON
-                return self._json(500, {"error": str(e)})
+                return self._json(500, {"error": str(e)},
+                                  headers=rid_headers)
 
         def do_POST(self):
             self._dispatch(arg_from_body=True)

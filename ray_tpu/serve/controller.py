@@ -26,6 +26,13 @@ class ServeController:
         #          "target": int}
         self._deployments: Dict[str, dict] = {}
         self._lock = locksan.lock("serve.controller")
+        # (due_ts, metric, tags) for a second gauge_delete ~1s after a
+        # replica kill: kill() is async, so the dying replica can still
+        # publish its queue depth with a ts NEWER than the immediate
+        # delete marker (the plane's tombstone only refuses older-ts
+        # stragglers); once the process is actually dead a re-delete
+        # is strictly the newest write and retires the series for good
+        self._retire_queue: List[tuple] = []
         self._autoscale_thread = threading.Thread(
             target=self._autoscale_loop, daemon=True)
         self._autoscale_thread.start()
@@ -56,7 +63,10 @@ class ServeController:
         with self._lock:
             rec = self._deployments.pop(name, None)
         if rec:
-            self._stop_replicas(rec["replicas"])
+            tags = rec.get("replica_tags") or []
+            pairs = [(r, tags[i] if i < len(tags) else None)
+                     for i, r in enumerate(rec["replicas"])]
+            self._stop_replicas(pairs, name)
 
     def shutdown(self) -> None:
         with self._lock:
@@ -89,29 +99,76 @@ class ServeController:
             opts = dict(rec["actor_options"])
             opts.setdefault("max_concurrency", rec["max_concurrency"])
         while have < want:
+            with self._lock:
+                # monotonic per-deployment tag (indices shift as
+                # replicas stop; the tag names THIS replica forever in
+                # access logs, metrics and worker log prefixes)
+                tag = rec["next_replica_seq"] = \
+                    rec.get("next_replica_seq", 0) + 1
             replica = rep.Replica.options(**opts).remote(
-                cls_blob, args, kwargs, name)
+                cls_blob, args, kwargs, name, str(tag - 1))
             with self._lock:
                 rec["replicas"].append(replica)
+                rec.setdefault("replica_tags", []).append(str(tag - 1))
             have += 1
         excess = []
         with self._lock:
+            tags = rec.setdefault("replica_tags", [])
             while len(rec["replicas"]) > want:
-                excess.append(rec["replicas"].pop())
-        self._stop_replicas(excess)
+                excess.append((rec["replicas"].pop(),
+                               tags.pop() if tags else None))
+        self._stop_replicas(excess, name)
 
-    def _stop_replicas(self, replicas: List[Any]) -> None:
+    def _stop_replicas(self, replicas: List[Any], name: str) -> None:
         from .. import kill
-        for r in replicas:
+        zeroed = False
+        for r, tag in replicas:
             try:
                 kill(r)
             except Exception:
                 pass
+            if tag is not None:
+                zeroed = True
+                # retire the stopped replica's queue-depth series so
+                # serve_health's sum/table — and every raw gauge
+                # surface (Prometheus scrape, dashboard, summary) —
+                # forget the dead replica instead of reporting its
+                # last value forever (a replica that CRASHES rather
+                # than being stopped is the open replica-death gap of
+                # ROADMAP item 5)
+                from . import replica as rep
+                from .._private import telemetry
+                tags = (("deployment", name or "default"),
+                        ("replica", tag))
+                telemetry.gauge_delete(rep.M_SERVE_QUEUE_DEPTH, tags)
+                with self._lock:
+                    self._retire_queue.append(
+                        (time.time() + 1.0,
+                         rep.M_SERVE_QUEUE_DEPTH, tags))
+        if zeroed:
+            # ship the zeros NOW: the controller itself may be killed
+            # right after a delete (serve.shutdown), and the
+            # rate-limited task-boundary flush could skip them
+            from .._private import telemetry
+            telemetry.flush()
+
+    def _flush_retires(self) -> None:
+        now = time.time()
+        with self._lock:
+            due = [e for e in self._retire_queue if e[0] <= now]
+            self._retire_queue = [e for e in self._retire_queue
+                                  if e[0] > now]
+        if due:
+            from .._private import telemetry
+            for _ts, metric, tags in due:
+                telemetry.gauge_delete(metric, tags)
+            telemetry.flush()
 
     def _autoscale_loop(self) -> None:
         from .. import get
         while True:
             time.sleep(0.25)
+            self._flush_retires()
             with self._lock:
                 items = [(n, rec) for n, rec in self._deployments.items()
                          if rec.get("autoscaling")]

@@ -42,9 +42,13 @@ def _handler(gateway):
         if err is not None:
             return err
         try:
+            # a caller-supplied "request_id" is honored (mirrors the
+            # HTTP X-Request-ID contract)
             result = gateway.call(name, req.get("arg"),
                                   model_id=req.get(
-                                      "multiplexed_model_id"))
+                                      "multiplexed_model_id"),
+                                  request_id=req.get("request_id"),
+                                  proto="grpc")
             return json.dumps({"result": result}).encode()
         except Exception as e:   # noqa: BLE001 — wire errors as JSON
             return json.dumps({"error": str(e)}).encode()
@@ -57,7 +61,9 @@ def _handler(gateway):
         try:
             it = gateway.stream(name, req.get("arg"),
                                 model_id=req.get(
-                                    "multiplexed_model_id"))
+                                    "multiplexed_model_id"),
+                                request_id=req.get("request_id"),
+                                proto="grpc")
             for item in it:
                 yield json.dumps({"item": item}).encode()
         except Exception as e:   # noqa: BLE001 — terminal error frame

@@ -14,7 +14,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import get
+from .._private import context as _pctx
 from .._private import locksan
+from . import request_context as _rc
 
 _REFRESH_S = 1.0
 
@@ -22,6 +24,7 @@ _REFRESH_S = 1.0
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller):
         self.deployment_name = deployment_name
+        self._default_route = f"/{deployment_name}"
         self._controller = controller
         self._replicas: List[Any] = []
         self._inflight: Dict[int, int] = {}
@@ -104,29 +107,63 @@ class DeploymentHandle:
         """Route one request; returns an ObjectRef."""
         return self._route(None, *args, **kwargs)
 
+    def _request_meta(self, model_id) -> Optional[tuple]:
+        """Request metadata shipped to the replica in
+        ``spec.request_ctx``: the ingress context when one is bound
+        (HTTP/gRPC gateways), a fresh one otherwise (plain Python
+        callers) — every request gets an id. ``enqueued_at`` is stamped
+        HERE so the replica's queue-wait measurement covers routing +
+        actor-call queueing. A compact TUPLE riding INSIDE the one spec
+        pickle stream — NOT an extra arg slot, which costs a separate
+        pickle + load per call (the request_ab overhead gate prices
+        this path)."""
+        if not _rc.enabled():
+            return None
+        ctx = _rc.current()
+        if ctx is not None:
+            # default route/proto ship as None (replica reconstructs):
+            # the tuple is pickled on every SUBMIT and EXECUTE frame
+            route = ctx.get("route")
+            if route == self._default_route:
+                route = None
+            proto = ctx.get("proto", "python")
+            return (ctx.get("request_id") or _rc.new_request_id(),
+                    route,
+                    None if proto == "python" else proto,
+                    time.time(), model_id)
+        return (_rc.new_request_id(), None, None, time.time(), model_id)
+
     def _route(self, model_id, *args, **kwargs):
         self._refresh()
-        for attempt in range(3):
-            idx = (self._pick() if model_id is None
-                   else self._pick_for_model(model_id))
-            with self._lock:
-                replica = self._replicas[idx]
-            try:
-                if model_id is None:
-                    ref = replica.handle_request.remote(*args, **kwargs)
-                else:
-                    ref = replica.handle_request_mux.remote(
-                        model_id, *args, **kwargs)
-            except Exception:
-                self._done(idx)
+        meta = self._request_meta(model_id)
+        token = (_pctx.request_ctx.set(meta)
+                 if meta is not None else None)
+        try:
+            for attempt in range(3):
+                idx = (self._pick() if model_id is None
+                       else self._pick_for_model(model_id))
                 with self._lock:
-                    if self._model_affinity.get(model_id) == idx:
-                        del self._model_affinity[model_id]
-                self._refresh(force=True)
-                continue
-            # in-flight slot released when the response is consumed
-            return _TrackedRef(ref, self, idx)
-        raise RuntimeError("no live replica accepted the request")
+                    replica = self._replicas[idx]
+                try:
+                    if model_id is None:
+                        ref = replica.handle_request.remote(*args,
+                                                            **kwargs)
+                    else:
+                        ref = replica.handle_request_mux.remote(
+                            model_id, *args, **kwargs)
+                except Exception:
+                    self._done(idx)
+                    with self._lock:
+                        if self._model_affinity.get(model_id) == idx:
+                            del self._model_affinity[model_id]
+                    self._refresh(force=True)
+                    continue
+                # in-flight slot released when the response is consumed
+                return _TrackedRef(ref, self, idx)
+            raise RuntimeError("no live replica accepted the request")
+        finally:
+            if token is not None:
+                _pctx.request_ctx.reset(token)
 
     def stream(self, *args, **kwargs):
         """Route one STREAMING request: the deployment's handler must
@@ -137,28 +174,36 @@ class DeploymentHandle:
 
     def _route_stream(self, model_id, *args, **kwargs):
         self._refresh()
-        for attempt in range(3):
-            idx = (self._pick() if model_id is None
-                   else self._pick_for_model(model_id))
-            with self._lock:
-                replica = self._replicas[idx]
-            try:
-                if model_id is None:
-                    gen = replica.handle_request.options(
-                        num_returns="streaming").remote(*args, **kwargs)
-                else:
-                    gen = replica.handle_request_mux.options(
-                        num_returns="streaming").remote(
-                            model_id, *args, **kwargs)
-            except Exception:
-                self._done(idx)
+        meta = self._request_meta(model_id)
+        token = (_pctx.request_ctx.set(meta)
+                 if meta is not None else None)
+        try:
+            for attempt in range(3):
+                idx = (self._pick() if model_id is None
+                       else self._pick_for_model(model_id))
                 with self._lock:
-                    if self._model_affinity.get(model_id) == idx:
-                        del self._model_affinity[model_id]
-                self._refresh(force=True)
-                continue
-            return _TrackedStream(gen, self, idx)
-        raise RuntimeError("no live replica accepted the request")
+                    replica = self._replicas[idx]
+                try:
+                    if model_id is None:
+                        gen = replica.handle_request.options(
+                            num_returns="streaming").remote(*args,
+                                                            **kwargs)
+                    else:
+                        gen = replica.handle_request_mux.options(
+                            num_returns="streaming").remote(
+                                model_id, *args, **kwargs)
+                except Exception:
+                    self._done(idx)
+                    with self._lock:
+                        if self._model_affinity.get(model_id) == idx:
+                            del self._model_affinity[model_id]
+                    self._refresh(force=True)
+                    continue
+                return _TrackedStream(gen, self, idx)
+            raise RuntimeError("no live replica accepted the request")
+        finally:
+            if token is not None:
+                _pctx.request_ctx.reset(token)
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self._controller))

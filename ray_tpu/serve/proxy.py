@@ -7,6 +7,14 @@ pinned to its node with NodeAffinity, running the same JSON/NDJSON
 gateway the head's ``serve.start_http`` runs; any node's port serves
 every deployment (routing state comes from the controller, which is
 location-transparent).
+
+Request observability rides along for free: the shared
+``_GatewayHandler`` mints (or adopts, via ``X-Request-ID``) a request
+id per request, opens the ``request::ingress`` span, and binds the
+request context the handle ships to the replica — so a request through
+ANY node's proxy traces and logs identically to one through the head
+gateway. The proxy actor's own log lines carry its node in the worker
+prefix; replica lines carry their deployment name.
 """
 
 from __future__ import annotations

@@ -22,6 +22,8 @@ from .api import (  # noqa: F401
     list_workers,
     memory_summary,
     profile,
+    serve_health,
+    serve_requests,
     summarize_actors,
     summarize_metrics,
     summarize_tasks,

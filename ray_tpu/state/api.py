@@ -175,6 +175,17 @@ def shape_metrics(snap: Optional[dict]) -> List[dict]:
                      "sum": h.get("sum", 0.0),
                      "count": h.get("count", 0),
                      "exemplar": h.get("exemplar")})
+    from .._private import telemetry as _tm
+    for (name, tags), d in (snap.get("digests") or {}).items():
+        rows.append({**base(name, tags), "kind": "digest",
+                     "sum": d.get("sum", 0.0),
+                     "count": d.get("count", 0),
+                     "min": d.get("min"), "max": d.get("max"),
+                     "quantiles": {
+                         "p50": _tm.digest_quantile(d, 0.50),
+                         "p90": _tm.digest_quantile(d, 0.90),
+                         "p95": _tm.digest_quantile(d, 0.95),
+                         "p99": _tm.digest_quantile(d, 0.99)}})
     rows.sort(key=lambda r: (r["name"], sorted(r["tags"].items())))
     return rows
 
@@ -252,12 +263,15 @@ def list_metrics(filters: Optional[dict] = None,
     return rows[:limit]
 
 
-def summarize_metrics() -> Dict[str, Any]:
+def summarize_metrics(snap: Optional[dict] = None) -> Dict[str, Any]:
     """Per-metric rollup: series count plus a kind-appropriate total
     (counter sum, latest gauge values, histogram count/mean) — the
-    ``ray summary``-style view of the telemetry table."""
+    ``ray summary``-style view of the telemetry table. Pass ``snap``
+    to roll up an already-fetched snapshot (health_report fetches the
+    cluster table once and shares it across its sections)."""
     out: Dict[str, Any] = {}
-    for row in shape_metrics(_query("metrics")):
+    for row in shape_metrics(snap if snap is not None
+                             else _query("metrics")):
         ent = out.setdefault(row["name"], {
             "kind": row["kind"], "description": row["description"],
             "series": 0})
@@ -266,11 +280,20 @@ def summarize_metrics() -> Dict[str, Any]:
             ent["total"] = ent.get("total", 0.0) + row["value"]
         elif row["kind"] == "gauge":
             ent["last"] = row["value"]
-        else:
+        else:   # histogram and digest rows both carry count/sum
             ent["count"] = ent.get("count", 0) + row["count"]
             ent["sum"] = ent.get("sum", 0.0) + row["sum"]
             if ent["count"]:
                 ent["mean"] = ent["sum"] / ent["count"]
+            if row["kind"] == "digest" and row.get("quantiles"):
+                # quantiles don't aggregate across tag-sets: keep them
+                # only while the name has ONE series — pairing a merged
+                # count with one series' percentiles would mislead
+                # (use serve_health / list_metrics for per-tag views)
+                if ent["series"] == 1:
+                    ent["quantiles"] = row["quantiles"]
+                else:
+                    ent.pop("quantiles", None)
     return out
 
 
@@ -290,6 +313,131 @@ def memory_summary(group_by: str = "callsite", top_k: int = 20,
     out["leaks"] = shape_leaks(mem.get("leaks"))
     out["stores"] = mem.get("stores") or {}
     return out
+
+
+def shape_serve_health(snap: Optional[dict]) -> Dict[str, Any]:
+    """Per-deployment serving health from one merged metrics snapshot —
+    the exact tuple the autoscaler consumes: latency / queue-wait /
+    batch-size percentiles (streaming digests), live queue depth, a
+    per-replica table, and request/error totals. Shared by
+    ``state.serve_health()``, the dashboard ``GET /api/serve`` (which
+    reads the head's table with no client) and ``rtpu serve-status``."""
+    from .._private import telemetry as _tm
+    snap = snap or {}
+    deps: Dict[str, dict] = {}
+
+    def ent(name: str) -> dict:
+        d = deps.get(name)
+        if d is None:
+            d = deps[name] = {
+                "deployment": name, "requests_total": 0.0,
+                "errors_total": 0.0, "error_rate": 0.0,
+                "queue_depth": 0.0, "replicas": [],
+                "latency": {}, "queue_wait": {}, "batch_size": {},
+            }
+        return d
+
+    for (name, tags), value in (snap.get("counters") or {}).items():
+        if name != "rtpu_serve_requests_total":
+            continue
+        t = dict(tags)
+        d = ent(t.get("deployment", "default"))
+        d["requests_total"] += value
+        if t.get("status") == "error":
+            d["errors_total"] += value
+    for (name, tags), (value, _ts) in (snap.get("gauges") or {}).items():
+        if name != "rtpu_serve_replica_queue_depth":
+            continue
+        if value != value or value < 0:
+            continue    # in-flight delete marker / defensive
+        t = dict(tags)
+        d = ent(t.get("deployment", "default"))
+        d["queue_depth"] += value
+        d["replicas"].append({"replica": t.get("replica", "0"),
+                              "queue_depth": value})
+    digest_fields = {
+        "rtpu_serve_request_latency_digest_seconds": "latency",
+        "rtpu_serve_queue_wait_digest_seconds": "queue_wait",
+        "rtpu_serve_batch_size_digest": "batch_size",
+    }
+    for (name, tags), d in (snap.get("digests") or {}).items():
+        field = digest_fields.get(name)
+        if field is None:
+            continue
+        t = dict(tags)
+        rec = ent(t.get("deployment", "default"))
+        rec[field] = {
+            "p50": _tm.digest_quantile(d, 0.50),
+            "p95": _tm.digest_quantile(d, 0.95),
+            "p99": _tm.digest_quantile(d, 0.99),
+            "count": d.get("count", 0),
+            "mean": (d.get("sum", 0.0) / d["count"]
+                     if d.get("count") else 0.0),
+            "max": d.get("max"),
+        }
+    worst = None
+    for d in deps.values():
+        d["replicas"].sort(key=lambda r: r["replica"])
+        if d["requests_total"]:
+            d["error_rate"] = d["errors_total"] / d["requests_total"]
+        # worst = highest error rate, then highest p99 latency — the
+        # deployment the doctor names first
+        key = (d["error_rate"], (d["latency"] or {}).get("p99", 0.0))
+        if worst is None or key > worst[0]:
+            worst = (key, d["deployment"])
+    return {"deployments": deps,
+            "worst": worst[1] if worst else None}
+
+
+def serve_health() -> Dict[str, Any]:
+    """Cluster-wide serving health: per-deployment latency/queue-wait/
+    batch-size percentiles (from the streaming digests), queue depth,
+    error rate and the replica table (see ``shape_serve_health``)."""
+    return shape_serve_health(_query("metrics"))
+
+
+def serve_requests(limit: int = 100, slow: bool = False,
+                   errors: bool = False,
+                   timeout_s: float = 10.0) -> List[dict]:
+    """Recent structured access-log rows gathered from every serve
+    replica's ring (``rtpu requests``): request_id, deployment,
+    replica, route, status, latency, queue wait, batch size. ``slow``
+    keeps rows at/over ``serve_slow_request_threshold_s``, ``errors``
+    keeps failures. Empty when serve is not running."""
+    from .. import get, get_actor
+    from ..serve.api import _CONTROLLER_NAME
+    try:
+        controller = get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        return []
+    import time as _time
+    rows: List[dict] = []
+    deadline = _time.monotonic() + timeout_s
+    try:
+        deployments = get(controller.list_deployments.remote(),
+                          timeout=timeout_s)
+        # submit the whole fan-out FIRST (replicas answer in parallel),
+        # then collect under one shared deadline — a dead replica costs
+        # at most the remaining budget once, not timeout_s serially per
+        # replica; get_replicas discovery is fanned out the same way
+        replica_refs = [controller.get_replicas.remote(name)
+                        for name in deployments]
+        refs = []
+        for rref in replica_refs:
+            for replica in get(rref, timeout=max(
+                    0.5, deadline - _time.monotonic())):
+                refs.append(replica.access_log.remote(limit, slow,
+                                                      errors))
+        for ref in refs:
+            try:
+                rows.extend(get(ref, timeout=max(
+                    0.5, deadline - _time.monotonic())))
+            except Exception:   # noqa: BLE001 — a dead replica is a
+                continue        # gap, not a failure
+    except Exception:   # noqa: BLE001 — controller mid-shutdown
+        return rows
+    rows.sort(key=lambda r: r.get("ts") or 0)
+    return rows[-limit:]
 
 
 def summarize_tasks() -> Dict[str, Any]:
@@ -401,8 +549,18 @@ def health_report() -> Dict[str, Any]:
     leaks = shape_leaks(mem.get("leaks"))
 
     highlights: Dict[str, Any] = {}
+    # ONE cluster-wide metrics snapshot, shared by the serve section
+    # and the telemetry highlights (two identical head RPCs otherwise)
     try:
-        metrics = summarize_metrics()
+        metrics_snap = _query("metrics")
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        metrics_snap = None
+    try:
+        serve = shape_serve_health(metrics_snap)
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        serve = {"deployments": {}, "worst": None}
+    try:
+        metrics = summarize_metrics(metrics_snap or {})
     except Exception:   # noqa: BLE001 — doctor degrades, never dies
         metrics = {}
     queue_wait = metrics.get("rtpu_scheduler_queue_wait_seconds") or {}
@@ -471,6 +629,18 @@ def health_report() -> Dict[str, Any]:
                  if named else "")
         problems.append(f"{len(leaks)} leaked object(s){where} "
                         "— see memory")
+    # serve: name the worst deployment (highest error rate, then p99);
+    # a deployment failing a quarter of a real request volume is a
+    # problem line, not just a table row
+    worst_name = serve.get("worst")
+    if worst_name:
+        wd = serve["deployments"].get(worst_name) or {}
+        if wd.get("error_rate", 0.0) >= 0.25 \
+                and wd.get("requests_total", 0.0) >= 4:
+            problems.append(
+                f"deployment {worst_name!r} failing "
+                f"{wd['error_rate']:.0%} of {wd['requests_total']:g} "
+                "request(s) — see serve")
     return {
         "healthy": not problems,
         "problems": problems,
@@ -487,6 +657,7 @@ def health_report() -> Dict[str, Any]:
                    "bytes": sum(r.get("size") or 0 for r in mem_rows),
                    "leaked": len(leaks),
                    "leaks": leaks[:10]},
+        "serve": serve,
         "recovery": recovery,
         "metrics": highlights,
     }
@@ -589,11 +760,66 @@ def _collective_trace_events() -> List[dict]:
     return trace
 
 
+def _request_trace_events() -> List[dict]:
+    """Serve request traces as Chrome-trace X events (``cat:
+    "request"``): every span belonging to a trace that contains a
+    ``request::`` span — the force-traced ingress/queue-wait/batch-
+    assembly/replica-execute spans AND any nested ``task::``/
+    ``actor_call::`` spans the deployment's own ``.remote()`` calls
+    produced (they share the request's trace id) — grouped one pid row
+    per request id, so one request reads as one timeline lane."""
+    from ..util import tracing
+    try:
+        tracing.flush()
+        spans = _query("spans") or []
+    except Exception:   # noqa: BLE001 — timeline degrades, never dies
+        return []
+    by_trace: Dict[str, List[dict]] = defaultdict(list)
+    for span in spans:
+        tid = span.get("trace_id")
+        if tid:
+            by_trace[tid].append(span)
+    trace: List[dict] = []
+    for tid, members in by_trace.items():
+        rid = None
+        is_request = False
+        for span in members:
+            if str(span.get("name", "")).startswith("request::"):
+                is_request = True
+                rid = rid or (span.get("attributes")
+                              or {}).get("request_id")
+        if not is_request:
+            continue                    # not a request trace
+        pid = f"request:{rid or tid[:8]}"
+        for span in members:
+            if span.get("end_time") is None:
+                continue
+            trace.append({
+                "name": span["name"],
+                "cat": "request",
+                "ph": "X",
+                "ts": span["start_time"] * 1e6,
+                "dur": max(span["end_time"] - span["start_time"],
+                           1e-6) * 1e6,
+                "pid": pid,
+                "tid": f"pid:{span.get('pid', '?')}",
+                "args": {"trace_id": tid,
+                         "span_id": span.get("span_id"),
+                         "parent_id": span.get("parent_id"),
+                         "status": span.get("status"),
+                         **(span.get("attributes") or {})},
+            })
+    return trace
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Chrome-trace JSON of task execution (reference: ``ray.timeline``,
     ``_private/state.py:865``), plus one span per completed collective
     call from the flight recorder (``cat: collective``, one row per
-    rank). Load the output in chrome://tracing or Perfetto."""
+    rank), plus one lane per traced serve request (``cat: request`` —
+    ingress/queue-wait/batch-assembly/replica-execute and the
+    request's nested task spans, keyed by request id). Load the output
+    in chrome://tracing or Perfetto."""
     events = _query("tasks") or []
     # pair RUNNING -> FINISHED/FAILED per task
     runs: Dict[Any, dict] = {}
@@ -618,6 +844,7 @@ def timeline(filename: Optional[str] = None) -> Any:
                 "args": {"state": ev["state"]},
             })
     trace.extend(_collective_trace_events())
+    trace.extend(_request_trace_events())
     if filename is not None:
         with open(filename, "w") as f:
             json.dump(trace, f)

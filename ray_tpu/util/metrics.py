@@ -111,7 +111,7 @@ def format_prometheus(snap: dict, include_exemplars: bool = True) -> str:
         ent = by_name.get(name)
         if ent is None:
             ent = by_name[name] = {"counters": [], "gauges": [],
-                                   "hists": []}
+                                   "hists": [], "digests": []}
         return ent
 
     for (name, tags), value in (snap.get("counters") or {}).items():
@@ -120,6 +120,8 @@ def format_prometheus(snap: dict, include_exemplars: bool = True) -> str:
         series_of(name)["gauges"].append((tags, value))
     for (name, tags), h in (snap.get("hists") or {}).items():
         series_of(name)["hists"].append((tags, h))
+    for (name, tags), d in (snap.get("digests") or {}).items():
+        series_of(name)["digests"].append((tags, d))
     if snap.get("dropped_series"):
         series_of("rtpu_telemetry_dropped_series_total")["counters"].append(
             ((), float(snap["dropped_series"])))
@@ -133,7 +135,12 @@ def format_prometheus(snap: dict, include_exemplars: bool = True) -> str:
         ent = by_name[name]
         m = meta.get(name) or {}
         kind = m.get("kind") or ("histogram" if ent["hists"] else
+                                 "summary" if ent["digests"] else
                                  "gauge" if ent["gauges"] else "counter")
+        # quantile digests export as the Prometheus summary type
+        # (quantile-labelled gauge lines + _sum/_count)
+        if kind == "digest":
+            kind = "summary"
         desc = m.get("description") or ""
         if desc:
             lines.append(f"# HELP {name} {_escape_help(desc)}")
@@ -166,6 +173,15 @@ def format_prometheus(snap: dict, include_exemplars: bool = True) -> str:
             lines.append(f"{name}_sum{_fmt_tags(tags)} "
                          f"{float(h.get('sum', 0.0))}")
             lines.append(f"{name}_count{_fmt_tags(tags)} {total}")
+        for tags, d in sorted(ent["digests"], key=lambda kv: kv[0]):
+            for q in (0.5, 0.9, 0.95, 0.99):
+                lines.append(
+                    f"{name}{_fmt_tags(tags + (('quantile', str(q)),))} "
+                    f"{telemetry.digest_quantile(d, q)}")
+            lines.append(f"{name}_sum{_fmt_tags(tags)} "
+                         f"{float(d.get('sum', 0.0))}")
+            lines.append(f"{name}_count{_fmt_tags(tags)} "
+                         f"{int(d.get('count', 0))}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

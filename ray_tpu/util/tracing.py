@@ -55,7 +55,13 @@ def propagation_context() -> Optional[Dict[str, str]]:
     """What a submitter puts into the task spec. When tracing is on but
     no span is open, an EMPTY dict still rides along: it tells the
     executing node "trace this" even if that node's own config has
-    tracing off (remote nodes don't see the driver's _system_config)."""
+    tracing off (remote nodes don't see the driver's _system_config).
+    An OPEN span propagates even when this process's config has tracing
+    off — force-traced spans (serve request ingress, a spec that said
+    "trace this") must not lose their trace at the next task boundary."""
+    span = current_span()
+    if span is not None:
+        return {"trace_id": span["trace_id"], "span_id": span["span_id"]}
     if not enabled():
         return None
     return get_current_context() or {}
@@ -156,6 +162,23 @@ def flush() -> None:
         client.send_profile_event("spans", spans)
     except Exception:          # noqa: BLE001 — tracing must never break work
         pass
+
+
+_last_flush = 0.0
+
+
+def maybe_flush(min_interval_s: float = 0.2) -> None:
+    """Rate-limited flush for per-request call sites (the serve
+    gateway): frequent enough that request lanes assemble promptly
+    under traffic, bounded so a request storm doesn't pay one
+    control-plane span frame each. Readers that need freshness
+    (``state.list_spans`` / the timeline's request-lane builder) call
+    ``flush()`` directly."""
+    global _last_flush
+    now = time.monotonic()
+    if now - _last_flush >= min_interval_s:
+        _last_flush = now
+        flush()
 
 
 def _local_requeue(spans: List[dict]) -> None:

@@ -354,7 +354,7 @@ def test_spec_wire_roundtrip():
         owner_id=WorkerID.from_random().binary(),
         origin_node_id=NodeID.from_random().binary(), namespace="ns",
         runtime_env={"env_vars": {"A": "1"}}, trace_context={"t": 1},
-        accel_ids=[0, 1])
+        accel_ids=[0, 1], request_ctx=("r", "/r", "http", 1.0, None))
     # every field set to a NON-default value above; fail if a new field
     # was added without updating this test + __reduce__
     for f in dataclasses.fields(P.TaskSpec):

@@ -100,6 +100,38 @@ def test_memory_endpoint(dashboard):
     assert mine[0]["ref_types"].get("LOCAL_REFERENCE", 0) >= 1
 
 
+def test_serve_endpoint(dashboard):
+    """GET /api/serve shapes the request-observability plane (latency/
+    queue digests, queue depth, replica table, error rate) from the
+    head's merged metrics table — no client in the serving process."""
+    import time
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    def pong(x):
+        return {"pong": x}
+
+    try:
+        handle = serve.run(pong.bind())
+        for i in range(3):
+            assert handle.remote(i).result(timeout=15) == {"pong": i}
+        deadline = time.monotonic() + 15
+        dep = None
+        while time.monotonic() < deadline:
+            data = _fetch_json(dashboard.port, "/api/serve")
+            dep = (data["serve"].get("deployments") or {}).get("pong")
+            if dep and (dep.get("latency") or {}).get("count", 0) >= 3:
+                break
+            time.sleep(0.25)
+        assert dep, "deployment never reached /api/serve"
+        assert dep["latency"]["p50"] > 0 and dep["latency"]["p99"] > 0
+        assert dep["requests_total"] >= 3 and dep["error_rate"] == 0.0
+        assert dep["replicas"] and "queue_depth" in dep["replicas"][0]
+    finally:
+        serve.shutdown()
+
+
 def test_html_page_and_404(dashboard):
     status, body = _fetch(dashboard.port, "/")
     assert status == 200 and b"ray_tpu dashboard" in body

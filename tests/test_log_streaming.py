@@ -52,6 +52,29 @@ def test_actor_print_reaches_driver(rtpu_init, capsys):
     _wait_for(capsys, "actor-says-moo")
 
 
+def test_serve_replica_log_attribution(rtpu_init, capsys):
+    """Lines printed inside a serve replica carry the deployment name
+    (deployment#tag) in the ``(worker ...)`` prefix instead of a bare
+    worker id, so driver output / `rtpu logs` is greppable by
+    deployment (ISSUE 13 satellite)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    def chatty_dep(x):
+        print("hello-from-serve-replica")
+        return x
+
+    try:
+        handle = serve.run(chatty_dep.bind())
+        assert handle.remote(1).result(timeout=60) == 1
+        out = _wait_for(capsys, "hello-from-serve-replica")
+        line = next(ln for ln in out.splitlines()
+                    if "hello-from-serve-replica" in ln)
+        assert line.startswith("(worker chatty_dep#0 "), line
+    finally:
+        serve.shutdown()
+
+
 def test_multinode_logs_reach_driver(rtpu_cluster, capsys):
     cluster = rtpu_cluster
     cluster.add_node(num_cpus=2, resources={"side": 2.0})

@@ -817,3 +817,70 @@ def test_cross_node_ring_collective(tcp_cluster):
         assert digest == want
         # w=2 ring: each rank ships ~half the tensor twice (rs + ag)
         assert size * 0.9 <= sent <= size * 1.3
+
+
+def test_cross_node_request_trace_stitches(tcp_cluster):
+    """ISSUE 13 satellite: one HTTP request whose ingress runs in the
+    driver (attached to node A) and whose replica is pinned to node B
+    stitches into a single request trace — ingress, queue-wait and
+    replica-execute spans share the request id and render as one
+    ``cat: "request"`` lane in state.timeline(), with the replica-side
+    spans coming from a different process than the ingress."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu import state as rstate
+
+    tcp_cluster.add_node(num_cpus=2, resources={"srv": 2.0})
+    _wait_for_nodes(2)
+
+    @serve.deployment(ray_actor_options={"resources": {"srv": 1.0}})
+    def far_echo(x):
+        return {"ok": x}
+
+    rid = "ba5eba1100000042"
+    try:
+        serve.run(far_echo.bind())
+        url = serve.start_http(port=0)          # ingress: driver, node A
+        req = urllib.request.Request(
+            f"{url}/far_echo", data=_json.dumps({"v": 1}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": rid})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert _json.loads(resp.read())["result"] == {"ok": {"v": 1}}
+            assert resp.headers.get("X-RTPU-Request-ID") == rid
+
+        # replica spans arrive over the TCP plane after the call's task
+        # boundary — poll the lane together
+        want = {"request::ingress", "request::queue_wait",
+                "request::replica_execute"}
+        events = []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            events = [e for e in rstate.timeline()
+                      if e.get("cat") == "request"
+                      and e["pid"] == f"request:{rid}"]
+            if want <= {e["name"] for e in events}:
+                break
+            time.sleep(0.4)
+        names = {e["name"] for e in events}
+        assert want <= names, f"lane never stitched: {names}"
+        # single trace id across the whole lane
+        assert len({e["args"]["trace_id"] for e in events}) == 1
+        # the ingress span ran in THIS driver process; the replica
+        # spans ran in a different one (the node-B worker — the srv
+        # resource exists only there)
+        import os as _os
+        ingress = next(e for e in events
+                       if e["name"] == "request::ingress")
+        execute = next(e for e in events
+                       if e["name"] == "request::replica_execute")
+        assert ingress["tid"] == f"pid:{_os.getpid()}"
+        assert execute["tid"] != ingress["tid"]
+        # and the access-log row (fetched from the node-B replica)
+        # carries the same request id
+        rows = rstate.serve_requests()
+        assert any(r["request_id"] == rid for r in rows), rows
+    finally:
+        serve.shutdown()

@@ -50,18 +50,29 @@ def golden_task():
     return 1
 
 
+_GOLDEN_RID = "feedbead00000000"
+_GOLDEN_REQUEST_SPANS = {
+    "request::ingress", "request::queue_wait",
+    "request::replica_execute", "actor_call::Replica.handle_request",
+}
+
+
 def test_timeline_golden_file(rtpu_init, tmp_path):
     """Golden-file pin of the ``state.timeline()`` Chrome-trace JSON:
     event shape (name/cat/ph/args) byte-exact, variable fields (ts, dur,
-    node/task ids) normalized after type/positivity checks. Includes a
-    collective flight-recorder span (ISSUE 10: completed collective
-    calls render as ``cat: collective`` events, one row per rank).
+    node/task/trace ids) normalized after type/positivity checks.
+    Includes a collective flight-recorder span (ISSUE 10) AND one serve
+    request lane (ISSUE 13: a traced HTTP request renders as ``cat:
+    "request"`` events — ingress/queue-wait/replica-execute plus the
+    request's actor-call spans — keyed by its request id).
     Complements the span-based ``trace_timeline`` coverage in
     ``test_tracing_events.py``."""
     import os
+    import urllib.request
 
     import numpy as np
 
+    from ray_tpu import serve
     from ray_tpu.comm import collective as col
 
     ray_tpu.get([golden_task.remote() for _ in range(2)])
@@ -69,11 +80,37 @@ def test_timeline_golden_file(rtpu_init, tmp_path):
     # must show up as a deterministic `coll::allreduce` span
     col.init_collective_group(1, 0, group_name="tl")
     col.allreduce(np.ones(8, np.float32), group_name="tl")
-    out = str(tmp_path / "trace.json")
-    assert rstate.timeline(out) == out
     col.destroy_collective_group("tl")
-    with open(out) as f:
-        trace = json.load(f)
+
+    @serve.deployment
+    def golden_echo(x):
+        return {"ok": True}
+
+    try:
+        serve.run(golden_echo.bind())
+        url = serve.start_http(port=0)
+        req = urllib.request.Request(
+            f"{url}/golden_echo", data=json.dumps({"x": 1}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": _GOLDEN_RID})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["result"] == {"ok": True}
+        # replica-side spans ship at the actor call's task boundary —
+        # poll until the request lane is complete, then snapshot
+        trace = None
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            out = str(tmp_path / "trace.json")
+            assert rstate.timeline(out) == out
+            with open(out) as f:
+                trace = json.load(f)
+            lane = {e["name"] for e in trace
+                    if e.get("cat") == "request"}
+            if _GOLDEN_REQUEST_SPANS <= lane:
+                break
+            time.sleep(0.3)
+    finally:
+        serve.shutdown()
 
     normalized = []
     for ev in sorted(trace, key=lambda e: (e["name"], e["ts"])):
@@ -82,16 +119,30 @@ def test_timeline_golden_file(rtpu_init, tmp_path):
         if ev["cat"] == "collective":
             assert ev["pid"].startswith("coll:")
             pid = ev["pid"]                     # group name: literal
+            tid = ev["tid"]
+            args = ev["args"]
+        elif ev["cat"] == "request":
+            # fixed X-Request-ID => the lane's pid is literal; span/
+            # trace/task ids are random and normalize away
+            assert ev["pid"] == f"request:{_GOLDEN_RID}"
+            pid = ev["pid"]
+            tid = "<tid>"
+            args = {k: ("<id>" if k in ("trace_id", "span_id",
+                                        "parent_id", "task_id")
+                        and v is not None else v)
+                    for k, v in sorted(ev["args"].items())}
         else:
             assert ev["pid"].startswith("node:")
             pid = "node:<node>"
+            tid = "<tid>"
+            args = ev["args"]
         normalized.append({
             "name": ev["name"].rsplit(".", 1)[-1],
             "cat": ev["cat"], "ph": ev["ph"],
             "ts": "<ts>", "dur": "<dur>",
             "pid": pid,
-            "tid": ev["tid"] if ev["cat"] == "collective" else "<tid>",
-            "args": ev["args"],
+            "tid": tid,
+            "args": args,
         })
     golden_path = os.path.join(os.path.dirname(__file__), "golden",
                                "timeline.golden")

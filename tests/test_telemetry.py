@@ -302,3 +302,172 @@ def test_queue_wait_exemplar_links_trace(rtpu_init):
         assert f'trace_id="{trace_id}"' in text
     finally:
         CONFIG._values["tracing_enabled"] = old
+
+
+# ------------------------------------------------- quantile digests
+
+def test_digest_quantiles_bounded_memory():
+    """The streaming digest estimates p50/p95/p99 within ~2% on a
+    skewed distribution while holding at most ~2x the centroid cap —
+    no sample retention (ISSUE 13)."""
+    import random
+
+    rng = random.Random(7)
+    d = telemetry._Digest()
+    vals = [rng.lognormvariate(0.0, 0.5) for _ in range(50_000)]
+    for v in vals:
+        d.add(v)
+    payload = d.to_payload()
+    assert len(payload["centroids"]) <= 2 * telemetry._DIGEST_CENTROIDS
+    ordered = sorted(vals)
+    for q in (0.5, 0.9, 0.95, 0.99):
+        est = telemetry.digest_quantile(payload, q)
+        true = ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+        assert abs(est - true) / true < 0.02, (q, est, true)
+    # exact extremes survive compression
+    assert telemetry.digest_quantile(payload, 0.0) >= payload["min"]
+    assert telemetry.digest_quantile(payload, 1.0) <= payload["max"]
+
+
+def test_digest_merge_matches_single_stream():
+    """Sharded/per-process digests merged by the plane fold estimate
+    the same quantiles as one digest over the whole stream."""
+    import random
+
+    rng = random.Random(11)
+    vals = [rng.expovariate(1.0) for _ in range(30_000)]
+    parts = [telemetry._Digest() for _ in range(3)]
+    for i, v in enumerate(vals):
+        parts[i % 3].add(v)
+    merged = None
+    for p in parts:
+        merged = telemetry.merge_digest_payloads(merged, p.to_payload())
+    assert merged["count"] == len(vals)
+    assert len(merged["centroids"]) <= 2 * telemetry._DIGEST_CENTROIDS
+    ordered = sorted(vals)
+    for q in (0.5, 0.95, 0.99):
+        est = telemetry.digest_quantile(merged, q)
+        true = ordered[int(q * len(ordered))]
+        assert abs(est - true) / max(true, 1e-9) < 0.03, (q, est, true)
+
+
+def test_digest_empty_and_single():
+    assert telemetry.digest_quantile(None, 0.5) == 0.0
+    assert telemetry.digest_quantile({"count": 0}, 0.99) == 0.0
+    d = telemetry._Digest()
+    d.add(4.2)
+    assert telemetry.digest_quantile(d.to_payload(), 0.5) == \
+        pytest.approx(4.2)
+
+
+def test_digest_delta_flush_and_plane_merge():
+    """digest_observe rides the same delta flusher as histograms: the
+    collected delta resets the pending digest (second collect ships
+    nothing), the plane merges deltas cumulatively, and a failed-send
+    restore re-queues the delta without double-counting the local
+    cumulative view."""
+    name = "rtpu_test_flush_digest_seconds"
+    tags = (("case", "flush"),)
+    key = (name, tags)
+    for v in (0.1, 0.2, 0.3, 0.4):
+        telemetry.digest_observe(name, v, tags)
+    snap = telemetry.snapshot_local()
+    assert snap["digests"][key]["count"] == 4
+
+    payload = telemetry._collect_deltas()
+    assert payload["digests"][key]["count"] == 4
+    again = telemetry._collect_deltas()
+    assert again is None or key not in (again.get("digests") or {})
+    # local cumulative view unchanged by the flush
+    assert telemetry.snapshot_local()["digests"][key]["count"] == 4
+
+    plane = GlobalControlPlane()
+    plane.record_metrics(payload)
+    plane.record_metrics({"digests": {key: {"centroids": [[0.5, 2.0]],
+                                            "count": 2, "sum": 1.0,
+                                            "min": 0.5, "max": 0.5}}})
+    merged = plane.metrics_snapshot()["digests"][key]
+    assert merged["count"] == 6
+    assert merged["max"] == pytest.approx(0.5)
+
+    # failed send: restore re-queues the delta for the next collect
+    telemetry.digest_observe(name, 0.9, tags)
+    telemetry._last_digest_ship = 0.0    # bypass the ~1s ship cadence
+    payload2 = telemetry._collect_deltas()
+    telemetry._restore_deltas(payload2)
+    telemetry._last_digest_ship = 0.0
+    payload3 = telemetry._collect_deltas()
+    assert payload3["digests"][key]["count"] == \
+        payload2["digests"][key]["count"]
+    assert telemetry.snapshot_local()["digests"][key]["count"] == 5
+
+
+def test_digest_prometheus_summary_exposition():
+    snap = {
+        "digests": {("rtpu_test_latency_digest_seconds",
+                     (("deployment", "d"),)): {
+            "centroids": [[0.1, 50.0], [0.9, 50.0]],
+            "count": 100, "sum": 50.0, "min": 0.1, "max": 0.9}},
+        "meta": {"rtpu_test_latency_digest_seconds": {
+            "kind": "digest", "description": "latency digest"}},
+    }
+    text = rmetrics.format_prometheus(snap)
+    assert "# TYPE rtpu_test_latency_digest_seconds summary" in text
+    assert 'quantile="0.5"' in text and 'quantile="0.99"' in text
+    assert ('rtpu_test_latency_digest_seconds_count'
+            '{deployment="d"} 100') in text
+
+
+def test_gauge_delete_retires_series_everywhere():
+    """telemetry.gauge_delete ships a NaN marker that makes the plane
+    (and local snapshots) FORGET the series — no surface keeps
+    exporting a dead subject's last value or a sentinel (review finding
+    on ISSUE 13: stopped serve replicas' queue-depth rows)."""
+    name = "rtpu_test_retired_gauge"
+    tags = (("case", "retire"),)
+    key = (name, tags)
+    telemetry.gauge_set(name, 7.0, tags)
+    assert telemetry.snapshot_local()["gauges"][key][0] == 7.0
+    p1 = telemetry._collect_deltas()
+    plane = GlobalControlPlane()
+    plane.record_metrics(p1)
+    assert plane.metrics_snapshot()["gauges"][key][0] == 7.0
+
+    telemetry.gauge_delete(name, tags)
+    # local snapshot no longer shows the series
+    assert key not in telemetry.snapshot_local()["gauges"]
+    p2 = telemetry._collect_deltas()
+    marker = p2["gauges"][key][0]
+    assert marker != marker                       # NaN rides the delta
+    # failed-send restore must re-queue the marker, not lose it
+    telemetry._restore_deltas(p2)
+    p3 = telemetry._collect_deltas()
+    assert p3["gauges"][key][0] != p3["gauges"][key][0]
+    plane.record_metrics(p3)
+    assert key not in plane.metrics_snapshot()["gauges"]
+    # and the exposition never prints the marker
+    assert name not in rmetrics.format_prometheus(plane.metrics_snapshot())
+
+
+def test_gauge_delete_tombstone_refuses_stragglers():
+    """A delete marker tombstones the series at the marker's ts: an
+    older in-flight publish from the dying process (its flusher racing
+    the delete) must NOT resurrect the popped series, while a genuinely
+    newer set re-creates it (review finding on ISSUE 13: the dead
+    replica's queue-depth row came back forever)."""
+    name = "rtpu_test_straggler_gauge"
+    tags = (("case", "straggle"),)
+    key = (name, tags)
+    plane = GlobalControlPlane()
+    now = 1000.0
+    plane.record_metrics({"gauges": {key: (3.0, now)}})
+    assert plane.metrics_snapshot()["gauges"][key][0] == 3.0
+    # delete marker at now+1
+    plane.record_metrics({"gauges": {key: (float("nan"), now + 1)}})
+    assert key not in plane.metrics_snapshot()["gauges"]
+    # straggling older publish: refused, series stays gone
+    plane.record_metrics({"gauges": {key: (5.0, now + 0.5)}})
+    assert key not in plane.metrics_snapshot()["gauges"]
+    # a strictly newer set means the subject is genuinely back
+    plane.record_metrics({"gauges": {key: (9.0, now + 2)}})
+    assert plane.metrics_snapshot()["gauges"][key][0] == 9.0
