@@ -489,6 +489,35 @@ def request_ab() -> tuple:
             _st.median(ratios))
 
 
+def history_ab(nop) -> tuple:
+    """Metrics-history retention overhead gate (ISSUE 14): a tiny-task
+    submit burst with the retention ring at the shipped
+    ``metrics_history_capacity`` vs 0 (plane off — no snapshots, no
+    interval-digest folds), INTERLEAVED and compared at the per-arm
+    MEDIAN (same harness as ``recorder_ab``). Retention never touches
+    the record path — its whole cost is one plane-side table copy per
+    finest-step second on the head's tick plus a per-flush digest fold
+    — so the honest ratio is ~1.0; the < 1.05 budget trips on the
+    structural regression class (history work on the record path, a
+    snapshot outside the rate limit, unbounded frame growth). Returns
+    (on_s, off_s)."""
+    import statistics as _st
+
+    shipped = CONFIG.metrics_history_capacity or 120
+    burst = 300
+    times = {0: [], shipped: []}
+    try:
+        for _ in range(7):
+            for cap in (0, shipped):
+                CONFIG._values["metrics_history_capacity"] = cap
+                t0 = time.perf_counter()
+                ray_tpu.get([nop.remote() for _ in range(burst)])
+                times[cap].append(time.perf_counter() - t0)
+    finally:
+        CONFIG._values["metrics_history_capacity"] = shipped
+    return _st.median(times[shipped]), _st.median(times[0])
+
+
 def async_dispatch_ab(nop) -> tuple:
     """Same-box A/B of worker-lease pipelining: a tiny-task submit burst
     with the shipped ``worker_pipeline_depth`` vs depth 1 (leases off).
@@ -626,11 +655,17 @@ def main() -> None:
         # (< 1.05 — the ISSUE 13 bound; the per-request cost is a
         # context bind + two digest appends + a deque append)
         request_on_s, request_off_s, request_ratio = request_ab()
+        # metrics-history retention gate: the ISSUE 14 bound — the
+        # multi-resolution ring's cost lives on the head's 1/s tick,
+        # never the record path, so < 1.05 interleaved-median is ample
+        history_on_s, history_off_s = history_ab(nop)
+        history_ratio = history_on_s / max(history_off_s, 1e-9)
         ok = (submit_ratio < 1.2 and put_ratio < 1.2 and ns < 20_000
               and profile_ratio < 1.4 and prof_samples > 0
               and transport_ratio < 1.75 and collective_ratio < 0.9
               and dispatch_ratio < 1.05 and recorder_ratio < 1.05
-              and callsite_ratio < 1.05 and request_ratio < 1.05)
+              and callsite_ratio < 1.05 and request_ratio < 1.05
+              and history_ratio < 1.05)
         payload = {
             "metric": "telemetry_overhead",
             "submit_on_s": round(sub_on, 4),
@@ -662,6 +697,9 @@ def main() -> None:
             "request_on_s": round(request_on_s, 4),
             "request_off_s": round(request_off_s, 4),
             "request_ratio": round(request_ratio, 3),
+            "history_on_s": round(history_on_s, 4),
+            "history_off_s": round(history_off_s, 4),
+            "history_ratio": round(history_ratio, 3),
         }
     finally:
         try:

@@ -171,7 +171,38 @@ def init(address: Optional[Any] = None,
     _ctx.current_client = client
     _global_gcs.register_job(JobRecord(job_id=job_id, driver_pid=os.getpid(),
                                        start_time=time.time()))
+    _install_driver_failure_hook()
     atexit.register(shutdown)
+
+
+_prev_excepthook = None
+
+
+def _install_driver_failure_hook() -> None:
+    """Driver shutdown on an uncaught error is a terminal failure: hook
+    ``sys.excepthook`` (once per process, chained) so the dying driver
+    auto-captures a post-mortem debug bundle while its client is still
+    connected — the corpse `rtpu autopsy` reads after the session is
+    gone. Gated by ``debug_bundle_on_failure``."""
+    global _prev_excepthook
+    import sys as _sys
+    if _prev_excepthook is not None:
+        return
+    _prev_excepthook = _sys.excepthook
+
+    def _hook(tp, val, tb):
+        try:
+            if (_ctx.current_client is not None
+                    and not issubclass(tp, KeyboardInterrupt)):
+                from ._private import debug_bundle
+                debug_bundle.auto_capture(
+                    "driver_error",
+                    fields={"error": f"{tp.__name__}: {val}"})
+        except Exception:   # noqa: BLE001 — never mask the real error
+            pass
+        _prev_excepthook(tp, val, tb)
+
+    _sys.excepthook = _hook
 
 
 def _detect_tpus() -> int:

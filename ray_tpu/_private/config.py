@@ -178,6 +178,41 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                             "max distinct (name, tags) series the control "
                             "plane keeps; excess series are dropped and "
                             "counted"),
+    # --- metrics history & post-mortem bundles ---
+    "metrics_history_capacity": (int, 120,
+                                 "snapshot slots of the FINEST metrics-"
+                                 "history ring on the control plane "
+                                 "(coarser levels scale off it: level i "
+                                 "keeps capacity*(2+i)/2 slots, so the "
+                                 "default 120 yields 120/180/240); 0 "
+                                 "disables the whole history plane — "
+                                 "no periodic snapshots, no "
+                                 "metrics_history queries, no doctor "
+                                 "trends"),
+    "metrics_history_steps": (str, "1,10,60",
+                              "comma-separated seconds-per-snapshot of "
+                              "each history resolution level, finest "
+                              "first (multi-resolution ring: recent "
+                              "history is fine-grained, older history "
+                              "coarsens instead of vanishing)"),
+    "metrics_history_max_bytes": (int, 8 << 20,
+                                  "hard byte cap on the whole metrics-"
+                                  "history ring (estimated); oldest "
+                                  "finest-level frames evict first when "
+                                  "over budget, so retention degrades "
+                                  "gracefully under series churn"),
+    "debug_bundle_on_failure": (bool, True,
+                                "auto-capture a post-mortem debug "
+                                "bundle (rtpu debug-bundle) on terminal "
+                                "failures: collective reform budget "
+                                "exhaustion, memory-monitor OOM kills, "
+                                "and driver shutdown on an uncaught "
+                                "error — a chaos casualty leaves a "
+                                "corpse `rtpu autopsy` can read"),
+    "debug_bundle_dir": (str, "",
+                         "directory auto-captured debug bundles are "
+                         "written to (default: the session dir when "
+                         "known, else the system temp dir)"),
     # --- debugging / stall detection ---
     "stall_detector_interval_s": (float, 5.0,
                                   "control-plane stall sweep period; "

@@ -18,11 +18,19 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import history as history_mod
 from . import locksan
+from . import telemetry
 from .config import CONFIG
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from .object_store import ObjectMeta
 from .protocol import ActorSpec, PlacementGroupSpec
+
+M_EVENTS_EVICTED = telemetry.define(
+    "counter", "rtpu_events_evicted_total",
+    "Cluster events silently dropped from the bounded control-plane "
+    "ring (oldest-first, at cluster_events_buffer_size) — silent "
+    "history loss made observable")
 
 # Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
 ACTOR_PENDING = "PENDING_CREATION"
@@ -198,6 +206,24 @@ class GlobalControlPlane:
         self.task_events: deque = deque(maxlen=CONFIG.task_events_buffer_size)
         self.cluster_events: deque = deque(
             maxlen=CONFIG.cluster_events_buffer_size)
+        # node/actor/PG lifecycle state transitions, retained past death
+        # in their own bounded ring (task transitions already live in
+        # task_events) so `state.timeline()`, the dashboard and debug
+        # bundles can render "what the cluster was doing" after the
+        # subject is gone
+        self.lifecycle_events: deque = deque(
+            maxlen=CONFIG.cluster_events_buffer_size)
+        self._events_evicted = 0
+        # metrics history: multi-resolution retention rings fed by the
+        # hosting node's tick (record_history_snapshot); interval digest
+        # deltas accumulate here between ticks so each frame carries a
+        # true windowed quantile sketch, not a cumulative one
+        self.metrics_history = history_mod.MetricsHistory(
+            CONFIG.metrics_history_capacity,
+            CONFIG.metrics_history_steps,
+            CONFIG.metrics_history_max_bytes)
+        self._history_interval_digests: Dict[tuple, dict] = {}
+        self._history_last = 0.0
         self.spans: deque = deque(maxlen=CONFIG.span_buffer_size)
         # cluster-wide metrics table: merged deltas from every process's
         # telemetry shards (reference analogue: the head's Prometheus
@@ -339,6 +365,8 @@ class GlobalControlPlane:
         info.last_heartbeat = time.monotonic()
         with self._lock:
             self.nodes[info.node_id] = info
+            self._record_lifecycle_locked("node", info.node_id.hex(),
+                                          "ALIVE", address=info.address)
         self.publish("NODE", {"node_id": info.node_id, "state": "ALIVE"})
 
     def remove_node(self, node_id: NodeID, reason: str = "") -> None:
@@ -349,6 +377,8 @@ class GlobalControlPlane:
             if info is None:
                 return
             info.alive = False
+            self._record_lifecycle_locked("node", node_id.hex(), "DEAD",
+                                          reason=reason)
             # drop directory entries whose only location was this node
             lost = [oid for oid, (nid, _) in self.directory.items()
                     if nid == node_id]
@@ -469,6 +499,10 @@ class GlobalControlPlane:
             rec = self.actors.get(actor_id)
             if rec is None:
                 return
+            if rec.state != state:
+                self._record_lifecycle_locked(
+                    "actor", actor_id.hex(), state,
+                    class_name=rec.spec.name, reason=reason or None)
             rec.state = state
             if count_restart:
                 # worker-level restarts and node-death reroutes share ONE
@@ -692,6 +726,8 @@ class GlobalControlPlane:
         rec = {"spec": spec, "state": PG_CREATED, "assignment": assignment}
         with self._lock:
             self.placement_groups[spec.pg_id] = rec
+            self._record_lifecycle_locked("placement_group",
+                                          spec.pg_id.hex(), PG_CREATED)
             self._storage.append(("pgs", "put", rec))
 
     def get_pg(self, pg_id: PlacementGroupID) -> Optional[dict]:
@@ -703,6 +739,8 @@ class GlobalControlPlane:
             rec = self.placement_groups.pop(pg_id, None)
             if rec:
                 rec["state"] = PG_REMOVED
+                self._record_lifecycle_locked("placement_group",
+                                              pg_id.hex(), PG_REMOVED)
                 self._storage.append(("pgs", "del", pg_id))
         return rec
 
@@ -1329,11 +1367,50 @@ class GlobalControlPlane:
     # --------------------------------------- structured events + spans
     def record_cluster_event(self, rec: dict) -> None:
         with self._lock:
+            evicted = (self.cluster_events.maxlen is not None
+                       and len(self.cluster_events)
+                       == self.cluster_events.maxlen)
+            if evicted:
+                self._events_evicted += 1
             self.cluster_events.append(rec)
+        if evicted:
+            # outside the plane lock (counter_inc takes a telemetry
+            # shard lock): silent ring loss is itself observable
+            telemetry.counter_inc(M_EVENTS_EVICTED)
 
-    def list_cluster_events(self, limit: int = 1000) -> List[dict]:
+    def list_cluster_events(self, limit: int = 1000,
+                            since: Optional[float] = None,
+                            until: Optional[float] = None) -> List[dict]:
         with self._lock:
-            return list(self.cluster_events)[-limit:]
+            rows = list(self.cluster_events)
+        if since is not None:
+            rows = [r for r in rows if (r.get("timestamp") or 0) >= since]
+        if until is not None:
+            rows = [r for r in rows if (r.get("timestamp") or 0) <= until]
+        return rows[-limit:]
+
+    def events_stats(self) -> dict:
+        with self._lock:
+            return {"buffered": len(self.cluster_events),
+                    "capacity": self.cluster_events.maxlen,
+                    "evicted": self._events_evicted}
+
+    # -------------------------------------------- lifecycle transitions
+    def _record_lifecycle_locked(self, kind: str, ident: str, state: str,
+                                 **fields) -> None:
+        rec = {"kind": kind, "id": ident, "state": state,
+               "ts": time.time()}
+        rec.update({k: v for k, v in fields.items() if v is not None})
+        self.lifecycle_events.append(rec)
+
+    def lifecycle_snapshot(self, limit: int = 10000,
+                           since: Optional[float] = None) -> List[dict]:
+        """Node/actor/PG state transitions, retained past death."""
+        with self._lock:
+            rows = list(self.lifecycle_events)
+        if since is not None:
+            rows = [r for r in rows if r["ts"] >= since]
+        return rows[-limit:]
 
     def record_spans(self, spans: List[dict]) -> None:
         with self._lock:
@@ -1423,9 +1500,72 @@ class GlobalControlPlane:
                     self._metrics_conflict_keys.add(key)
             for key, d in (payload.get("digests") or {}).items():
                 if self._metric_series_ok(self.metrics_digests, key):
-                    from . import telemetry as _tm
-                    self.metrics_digests[key] = _tm.merge_digest_payloads(
-                        self.metrics_digests.get(key), d)
+                    self.metrics_digests[key] = \
+                        telemetry.merge_digest_payloads(
+                            self.metrics_digests.get(key), d)
+                    if (self.metrics_history.enabled
+                            and CONFIG.metrics_history_capacity > 0):
+                        # interval accumulator for the history plane: a
+                        # frame's quantiles cover the frame's WINDOW
+                        # (cumulative digests can't be subtracted)
+                        cur = self._history_interval_digests.get(key)
+                        self._history_interval_digests[key] = (
+                            telemetry.merge_digest_payloads(cur, d)
+                            if cur else dict(d))
+
+    def record_history_snapshot(self) -> Optional[int]:
+        """One metrics-history tick (triggered from the plane-hosting
+        node's tick loop, self-rate-limited to the finest level step
+        like the stall/leak sweeps): append the merge table's current
+        values plus the accumulated interval digests as a frame.
+        Returns the ring's estimated byte total, or ``None`` when
+        rate-limited/disabled."""
+        # the live CONFIG check (beside the ring's init-time flag) lets
+        # an A/B toggle retention off in-process (bench_telemetry's
+        # history_ab gate measures exactly this knob)
+        if not (self.metrics_history.enabled
+                and CONFIG.metrics_history_capacity > 0):
+            return None
+        now = time.time()
+        with self._lock:
+            finest = self.metrics_history.levels[0].step
+            if now - self._history_last < finest:
+                return None
+            self._history_last = now
+            counters = dict(self.metrics_counters)
+            gauges = {k: v[0] for k, v in self.metrics_gauges.items()}
+            hists = {k: (h["count"], h["sum"])
+                     for k, h in self.metrics_hists.items()}
+            interval = self._history_interval_digests
+            self._history_interval_digests = {}
+            return self.metrics_history.record(now, counters, gauges,
+                                               hists, interval)
+
+    def metrics_history_query(self, name: Optional[str] = None,
+                              tags: Optional[dict] = None,
+                              window: Optional[float] = None,
+                              step: Optional[float] = None) -> dict:
+        """Windowed aligned series from the retention ring (the
+        ``state.metrics_history()`` backend). The plane lock covers only
+        the cheap frame-ref snapshot; conversion/filtering of hundreds
+        of frames runs OUTSIDE it (frames are immutable once appended),
+        so doctor/dashboard/trend queries never stall scheduling."""
+        with self._lock:
+            snap = self.metrics_history.level_snapshot()
+            enabled = self.metrics_history.enabled
+        return history_mod.query_levels(snap, enabled, name=name,
+                                        tags=tags, window=window,
+                                        step=step)
+
+    def metrics_history_dump(self) -> dict:
+        """Whole-ring dump for debug bundles (offline replay); same
+        snapshot-then-convert-unlocked shape as the query path."""
+        with self._lock:
+            snap = self.metrics_history.level_snapshot()
+            enabled = self.metrics_history.enabled
+            total = self.metrics_history.total_bytes
+            evicted = self.metrics_history.frames_evicted
+        return history_mod.dump_levels(snap, enabled, total, evicted)
 
     def metrics_snapshot(self) -> dict:
         with self._lock:

@@ -44,6 +44,8 @@ _ALLOWED = frozenset({
     "record_provenance", "objects_info", "memory_state",
     "record_cluster_event", "list_cluster_events",
     "record_spans", "list_spans", "record_metrics", "metrics_snapshot",
+    "metrics_history_query", "metrics_history_dump", "lifecycle_snapshot",
+    "events_stats",
     "claim_actor_reroute",
     "requeue_actor_reroute",
     "gen_update", "gen_done", "gen_consumed", "gen_get", "gen_drop",

@@ -105,6 +105,8 @@ REGISTRY: Dict[str, tuple] = {
     # --- future nesting trips the sanitizer instead of passing silently)
     "events.file": ("_private/events.py", "lock", 44,
                     "events JSONL append serialization"),
+    "debug.bundle": ("_private/debug_bundle.py", "lock", 45,
+                     "auto-capture once-per-reason set"),
     "jobs.manager": ("job/manager.py", "lock", 46,
                      "job records + supervisor proc table"),
     "serve.controller": ("serve/controller.py", "lock", 48,

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions
 from . import events
+from . import history as history_mod
 from . import locksan
 from . import memory_monitor
 from . import protocol as P
@@ -929,6 +930,7 @@ class NodeService:
         self._rescue_stalled_waiters()
         self._sweep_stalls()
         self._sweep_object_leaks()
+        self._record_metrics_history()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
         self._dispatch()
@@ -1043,6 +1045,22 @@ class NodeService:
                 object_id=oid.hex(),
                 object_node_id=(loc.hex() if loc is not None else None),
                 **rec)
+
+    def _record_metrics_history(self) -> None:
+        """Tick-driven history snapshot: the plane-hosting node (same
+        rule as the stall/leak sweeps — the plane self-rate-limits to
+        its finest level step) flushes its own telemetry shards and
+        appends one retention frame, then publishes the ring's byte
+        footprint."""
+        if not isinstance(self.gcs, GlobalControlPlane):
+            return
+        try:
+            telemetry.maybe_flush(0.5)
+            total = self.gcs.record_history_snapshot()
+        except Exception:   # noqa: BLE001 — retention must not kill ticks
+            return
+        if total is not None:
+            telemetry.gauge_set(history_mod.M_HISTORY_BYTES, float(total))
 
     def _coll_stall_probe(self, candidates: List[tuple]) -> List[tuple]:
         """``collective_stuck`` half of the stall sweep (runs on the
@@ -1162,6 +1180,13 @@ class NodeService:
             top_objects=top,
             task=(victim.task.spec.name if victim.task else None),
             actor_id=(victim.actor_id.hex() if victim.actor_id else None))
+        # a kill under memory pressure is a terminal event worth a
+        # corpse: capture a post-mortem bundle off-thread (the tick
+        # must not stall on the stack/flight-record fan-outs)
+        from . import debug_bundle
+        debug_bundle.auto_capture("oom_kill", node=self,
+                                  fields={"victim_pid": pid},
+                                  background=True)
         try:
             if victim.proc is not None:
                 victim.proc.kill()
@@ -2001,6 +2026,15 @@ class NodeService:
                         str(ev_payload.get("message",
                                            "collective group reformed")),
                         **fields)
+                except Exception:   # noqa: BLE001 — accounting only
+                    pass
+            elif ev_kind == "debug_bundle":
+                # a driver/worker captured a post-mortem bundle; it has
+                # no EventLogger, so the literal emit lives here
+                try:
+                    rec = dict(ev_payload)
+                    msg = str(rec.pop("message", "debug bundle captured"))
+                    self.events.info("DEBUG_BUNDLE", msg, **rec)
                 except Exception:   # noqa: BLE001 — accounting only
                     pass
             elif ev_kind == "serve_request":
@@ -4332,6 +4366,19 @@ class NodeService:
             # full ring: the state API applies filters BEFORE its limit,
             # so a server-side cap would hide older matching rows
             return self.gcs.list_cluster_events(limit=10**9)
+        if what == "events_stats":
+            # ring occupancy + the eviction counter behind
+            # rtpu_events_evicted_total (silent history loss, observable)
+            return self.gcs.events_stats()
+        if what == "lifecycle":
+            return self.gcs.lifecycle_snapshot()
+        if what == "metrics_history":
+            f = filters or {}
+            return self.gcs.metrics_history_query(
+                name=f.get("name"), tags=f.get("tags"),
+                window=f.get("window"), step=f.get("step"))
+        if what == "metrics_history_dump":
+            return self.gcs.metrics_history_dump()
         if what == "spans":
             return self.gcs.list_spans(limit=10**9)
         if what == "metrics":

@@ -179,6 +179,13 @@ class _Digest:
                 "min": self.min, "max": self.max}
 
 
+def compress_centroids(items: List[list], k: int) -> List[list]:
+    """Public fold for OTHER holders of digest centroid lists (the
+    metrics-history rings recompress frame payloads to a coarser cap):
+    same k1 merge pass the live digests use."""
+    return _digest_compress(items, k)
+
+
 def merge_digest_payloads(cur: Optional[dict], new: dict) -> dict:
     """Merge two shipped digest payloads (the control-plane fold)."""
     if not cur or not cur.get("count"):

@@ -1085,6 +1085,17 @@ class FaultTolerantGroup:
             except TimeoutError as exc:
                 dead = _reformable(exc)
                 if not dead or attempt >= self.retries:
+                    if dead:
+                        # the reform budget is exhausted with a dead-rank
+                        # verdict standing: this call is terminal for the
+                        # training loop — leave a black-box bundle the
+                        # operator can autopsy offline
+                        from ray_tpu._private import debug_bundle
+                        debug_bundle.auto_capture(
+                            "collective_reform_exhausted",
+                            fields={"group": self.group_name,
+                                    "verdict": dead[0].get("message",
+                                                           "dead rank")})
                     raise
                 attempt += 1
                 before = _groups().get(self.group_name)

@@ -278,6 +278,31 @@ class _Handler(JsonHandler):
                 return self._json(200, {
                     "metrics": state_api.shape_metrics(snap),
                     "dropped_series": snap.get("dropped_series", 0)})
+            if path == "/api/metrics/history":
+                # windowed retention-ring series (?name=&window=&step=)
+                from urllib.parse import parse_qs
+                qs = parse_qs(self.path.split("?", 1)[1]
+                              if "?" in self.path else "")
+
+                def _num(key):
+                    try:
+                        return float(qs[key][0]) if key in qs else None
+                    except (ValueError, IndexError):
+                        return None
+
+                return self._json(200, {
+                    "history": node._state_query("metrics_history", {
+                        "name": (qs.get("name") or [None])[0],
+                        "window": _num("window"),
+                        "step": _num("step"),
+                    }) or {}})
+            if path == "/api/lifecycle":
+                # node/actor/PG state transitions retained past death
+                return self._json(200, {
+                    "lifecycle": node._state_query("lifecycle", None)
+                    or [],
+                    "events_stats": node._state_query("events_stats",
+                                                      None) or {}})
             if path == "/metrics":
                 # Prometheus scrape surface on the dashboard port (same
                 # merged table the JSON endpoint serves)

@@ -382,6 +382,71 @@ def check(repo_root: str = None) -> List[str]:
                     f"{name} ({where}): records tag {key!r} but the "
                     "README registry row does not declare that label")
     problems += check_events(root, files)
+    problems += check_bundle_sections(root, files)
+    return problems
+
+
+def check_bundle_sections(root: str, files=None) -> List[str]:
+    """Debug-bundle registry lint (both directions, like the config-knob
+    registry): every name in ``debug_bundle.BUNDLE_SECTIONS`` (the
+    manifest's section list) must have a ``_capture_<name>`` function
+    AND a ``_CAPTURERS`` dispatch entry, and every capturer must be
+    listed — a new observability surface can't silently miss the
+    bundle, and a dead section can't linger in the manifest schema."""
+    pkg = os.path.join(root, "ray_tpu")
+    if files is None:
+        files = list(_walk_files(pkg))
+    tree = None
+    for rel, t in files:
+        if rel.replace(os.sep, "/") == "_private/debug_bundle.py":
+            tree = t
+            break
+    if tree is None:
+        return ["_private/debug_bundle.py not found — the bundle "
+                "section lint has nothing to check"]
+    sections: List[str] = []
+    capturers: Set[str] = set()
+    dispatch: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            target = node.targets[0].id
+            if target == "BUNDLE_SECTIONS" and isinstance(
+                    node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if (isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, str)):
+                        sections.append(elt.value)
+            elif target == "_CAPTURERS" and isinstance(node.value,
+                                                       ast.Dict):
+                for k in node.value.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        dispatch.add(k.value)
+        elif (isinstance(node, ast.FunctionDef)
+                and node.name.startswith("_capture_")):
+            capturers.add(node.name[len("_capture_"):])
+    problems: List[str] = []
+    if not sections:
+        problems.append("debug_bundle.BUNDLE_SECTIONS is empty or not a "
+                        "literal tuple — the bundle scanner is broken")
+    dupes = {s for s in sections if sections.count(s) > 1}
+    for s in sorted(dupes):
+        problems.append(f"bundle section {s!r}: listed more than once "
+                        "in BUNDLE_SECTIONS")
+    listed = set(sections)
+    for s in sorted(listed - capturers):
+        problems.append(f"bundle section {s!r}: in BUNDLE_SECTIONS but "
+                        "no _capture_ function captures it")
+    for s in sorted(capturers - listed):
+        problems.append(f"bundle capturer _capture_{s}: not listed in "
+                        "BUNDLE_SECTIONS (the manifest would omit it)")
+    for s in sorted(listed - dispatch):
+        problems.append(f"bundle section {s!r}: missing from the "
+                        "_CAPTURERS dispatch table")
+    for s in sorted(dispatch - listed):
+        problems.append(f"bundle dispatch entry {s!r}: not listed in "
+                        "BUNDLE_SECTIONS")
     return problems
 
 

@@ -166,26 +166,8 @@ def cmd_memory(client, args) -> None:
             summary = {**summary, "objects": objects}
         print(json.dumps(summary, default=str, indent=2))
         return
-    print(f"{summary['total_objects']} tracked object(s), "
-          f"{_fmt_bytes(summary['total_bytes'])} cluster-wide")
-    for node_hex, st in sorted((summary.get("stores") or {}).items()):
-        print(f"  store {node_hex[:12]}: "
-              f"{_fmt_bytes(st.get('used_bytes'))} / "
-              f"{_fmt_bytes(st.get('capacity_bytes'))} used, "
-              f"{st.get('num_objects', 0)} object(s), "
-              f"{st.get('num_spilled', 0)} spilled")
-    order = ("most objects" if args.sort_by == "count"
-             else "most bytes")
-    print(f"\nBy {args.group_by} (top {args.limit}, {order} first):")
-    _print_table(
-        [{args.group_by: g["key"], "objects": g["objects"],
-          "bytes": _fmt_bytes(g["bytes"]),
-          "ref_types": _fmt_ref_types(g["ref_types"])}
-         for g in summary["groups"]],
-        [args.group_by, "objects", "bytes", "ref_types"])
-    if summary.get("dropped_groups"):
-        print(f"  (+{summary['dropped_groups']} more group(s); raise "
-              "--limit)")
+    _render_memory_summary(summary, args.group_by, args.limit,
+                           args.sort_by)
     if objects is not None:
         print("\nObjects (largest first):")
         _print_table(
@@ -194,6 +176,34 @@ def cmd_memory(client, args) -> None:
              for o in objects],
             ["object_id", "size", "callsite", "creator", "ref_types",
              "pinned_in_store", "spilled"])
+    _render_memory_leaks(summary)
+
+
+def _render_memory_summary(summary, group_by, limit, sort_by) -> None:
+    """Memory rollup renderer — live (`rtpu memory`) or from a bundle
+    (`rtpu autopsy`)."""
+    print(f"{summary['total_objects']} tracked object(s), "
+          f"{_fmt_bytes(summary['total_bytes'])} cluster-wide")
+    for node_hex, st in sorted((summary.get("stores") or {}).items()):
+        print(f"  store {node_hex[:12]}: "
+              f"{_fmt_bytes(st.get('used_bytes'))} / "
+              f"{_fmt_bytes(st.get('capacity_bytes'))} used, "
+              f"{st.get('num_objects', 0)} object(s), "
+              f"{st.get('num_spilled', 0)} spilled")
+    order = ("most objects" if sort_by == "count" else "most bytes")
+    print(f"\nBy {group_by} (top {limit}, {order} first):")
+    _print_table(
+        [{group_by: g["key"], "objects": g["objects"],
+          "bytes": _fmt_bytes(g["bytes"]),
+          "ref_types": _fmt_ref_types(g["ref_types"])}
+         for g in summary["groups"]],
+        [group_by, "objects", "bytes", "ref_types"])
+    if summary.get("dropped_groups"):
+        print(f"  (+{summary['dropped_groups']} more group(s); raise "
+              "--limit)")
+
+
+def _render_memory_leaks(summary) -> None:
     for leak in summary.get("leaks") or []:
         print(f"  ! LEAK [{leak.get('cause')}] object "
               f"{str(leak.get('object_id'))[:12]} "
@@ -268,11 +278,19 @@ def cmd_coll_debug(client, args) -> None:
     rank), and optionally the raw recent event ring per process."""
     from ..state import collective_health, flight_records
     report = collective_health(timeout_s=args.timeout)
+    records = flight_records(args.timeout) if args.records else None
     if args.format == "json":
-        if args.records:
-            report = {**report, "records": flight_records(args.timeout)}
+        if records is not None:
+            report = {**report, "records": records}
         print(json.dumps(report, default=str, indent=2))
         return
+    _render_coll(report)
+    if records is not None:
+        _render_coll_records(records, args.limit)
+
+
+def _render_coll(report) -> None:
+    """Collective-health renderer — live or from a bundle."""
     ops = report.get("ops") or []
     verdicts = report.get("verdicts") or []
     print(f"{report.get('processes', 0)} process(es) replied, "
@@ -291,31 +309,37 @@ def cmd_coll_debug(client, args) -> None:
         print(f"\n!!! [{v.get('verdict')}] {v.get('message')}")
         for fr in v.get("stack") or []:
             print(f"        {fr}")
-    if args.records:
-        recs = flight_records(args.timeout)
-        for node_hex, snaps in sorted(
-                (recs.get("nodes") or {}).items()):
-            for snap in snaps or []:
-                recent = snap.get("recent") or []
-                if not recent:
-                    continue
-                print(f"\n--- {snap.get('kind')} "
-                      f"{str(snap.get('worker_id'))[:12]} on "
-                      f"{node_hex}: last {len(recent)} event(s)")
-                for ev in recent[-args.limit:]:
-                    print(f"    {ev.get('ts'):.6f} {ev.get('kind'):8s} "
-                          f"{ev.get('key')} ({ev.get('info')})")
+
+
+def _render_coll_records(recs, limit: int) -> None:
+    for node_hex, snaps in sorted((recs.get("nodes") or {}).items()):
+        for snap in snaps or []:
+            recent = snap.get("recent") or []
+            if not recent:
+                continue
+            print(f"\n--- {snap.get('kind')} "
+                  f"{str(snap.get('worker_id'))[:12]} on "
+                  f"{node_hex}: last {len(recent)} event(s)")
+            for ev in recent[-limit:]:
+                print(f"    {ev.get('ts'):.6f} {str(ev.get('kind')):8s} "
+                      f"{ev.get('key')} ({ev.get('info')})")
 
 
 def cmd_serve_status(client, args) -> None:
     """Serving health plane: per-deployment latency/queue-wait
     percentiles (streaming digests), queue depth, error rate, replica
-    table — the autoscaling signal tuple."""
+    table — the autoscaling signal tuple. ``--trend N`` adds head/tail
+    movement over the trailing N seconds of retained history."""
     from ..state import serve_health
-    health = serve_health()
+    health = serve_health(trend=args.trend)
     if args.format == "json":
         print(json.dumps(health, default=str, indent=2))
         return
+    _render_serve(health)
+
+
+def _render_serve(health) -> None:
+    """Serve table renderer — live or from a bundle (`rtpu autopsy`)."""
     deps = health.get("deployments") or {}
     if not deps:
         print("no serve deployments observed")
@@ -344,6 +368,19 @@ def cmd_serve_status(client, args) -> None:
     _print_table(rows, ["deployment", "replicas", "queue", "reqs",
                         "err_rate", "p50", "p95", "p99", "qwait_p99",
                         "batch_p50"])
+    for name, tr in sorted((health.get("trend") or {}).items()):
+        parts = []
+        for field in ("queue_depth", "latency_p95", "queue_wait_p95",
+                      "request_rate"):
+            p = tr.get(field)
+            if p:
+                ratio = (f" ({p['ratio']}x)"
+                         if p.get("ratio") is not None else "")
+                parts.append(f"{field} {p['head']:g}->{p['tail']:g}"
+                             f"{ratio}")
+        if parts:
+            print(f"  trend[{tr.get('window_s')}s] {name}: "
+                  + ", ".join(parts))
     if health.get("worst"):
         print(f"\nworst deployment: {health['worst']}")
 
@@ -373,12 +410,19 @@ def cmd_requests(client, args) -> None:
 
 def cmd_doctor(client, args) -> None:
     """Correlated cluster health report: nodes, resources, task/actor
-    rollups, stall diagnoses, recent alerts, telemetry highlights."""
+    rollups, stall diagnoses, trend movements, recent alerts,
+    telemetry highlights."""
     from ..state import health_report
     rep = health_report()
     if args.format == "json":
         print(json.dumps(rep, default=str, indent=2))
         return
+    _render_doctor(rep)
+
+
+def _render_doctor(rep) -> None:
+    """Text renderer of one doctor report — live (`rtpu doctor`) or
+    replayed from a bundle (`rtpu autopsy`)."""
     verdict = "HEALTHY" if rep["healthy"] else "UNHEALTHY"
     print(f"cluster: {verdict}")
     for p in rep["problems"]:
@@ -393,6 +437,9 @@ def cmd_doctor(client, args) -> None:
     print(f"actors: {json.dumps(rep['actors'].get('by_state', {}))}")
     if rep["metrics"]:
         print(f"telemetry: {json.dumps(rep['metrics'])}")
+    for t in rep.get("trends") or []:
+        ratio = (f"{t['ratio']}x " if t.get("ratio") else "")
+        print(f"  TREND [{t.get('kind')}] {ratio}{t.get('message')}")
     for ev in rep["stalls"]:
         print(f"  STALL [{ev.get('cause')}] {ev.get('message')}")
     for v in (rep.get("collectives") or {}).get("verdicts", []):
@@ -424,6 +471,147 @@ def cmd_doctor(client, args) -> None:
     for ev in rep["alerts"]:
         print(f"  {ev.get('severity')} [{ev.get('label')}] "
               f"{ev.get('message')}")
+
+
+def _parse_when(spec, now: float):
+    """``--since/--until`` forms: epoch seconds (float), or relative
+    ``30s``/``5m``/``2h`` meaning that long before now."""
+    if spec is None:
+        return None
+    s = str(spec).strip()
+    try:
+        mult = {"s": 1.0, "m": 60.0, "h": 3600.0}.get(s[-1:])
+        if mult is not None:
+            return now - float(s[:-1]) * mult
+        return float(s)
+    except ValueError:
+        raise SystemExit(f"bad time spec {spec!r} (epoch seconds, or "
+                         "relative like 120s / 5m / 1h)")
+
+
+def cmd_events(client, args) -> None:
+    """Structured cluster events with time-window filtering
+    (``--since/--until``); the ring's eviction counter says whether
+    older rows were lost to retention."""
+    import time as _time
+
+    from ..state import events_stats, list_events
+    now = _time.time()
+    filters = {}
+    if args.label:
+        filters["label"] = args.label
+    if args.severity:
+        filters["severity"] = args.severity
+    rows = list_events(filters or None, limit=args.limit,
+                       since=_parse_when(args.since, now),
+                       until=_parse_when(args.until, now))
+    if args.format == "json":
+        print(json.dumps(rows, default=str, indent=2))
+        return
+    for r in rows:
+        ts = _time.strftime("%H:%M:%S",
+                            _time.localtime(r.get("timestamp") or 0))
+        print(f"{ts} {r.get('severity', '?'):7s} "
+              f"[{r.get('label')}] {r.get('message')}")
+    stats = events_stats()
+    if stats.get("evicted"):
+        print(f"({stats['evicted']} older event(s) evicted from the "
+              f"{stats.get('capacity')}-slot ring — see "
+              "rtpu_events_evicted_total)")
+
+
+def cmd_history(client, args) -> None:
+    """Windowed metric time series from the retention ring
+    (``state.metrics_history``): aligned points per series, with
+    rate/delta shaping for counters."""
+    from ..state import metrics_history
+    res = metrics_history(name=args.metric, window=args.window,
+                          step=args.step, shape=args.shape)
+    if args.format == "json":
+        print(json.dumps(res, default=str, indent=2))
+        return
+    series = res.get("series") or []
+    print(f"{len(series)} series, step {res.get('step_s')}s, "
+          f"window {res.get('window_s')}s")
+    for s in series[:args.limit]:
+        tags = ",".join(f"{k}={v}" for k, v in sorted(s["tags"].items()))
+        pts = s["points"]
+        shown = pts[-8:]
+
+        def fmt(v):
+            if isinstance(v, dict):
+                return (f"p95={v.get('p95'):.4g}" if "p95" in v
+                        else str(v))
+            return f"{v:.6g}"
+
+        print(f"  {s['name']}{{{tags}}} [{s['kind']}"
+              + (f", {s.get('shape')}" if s.get("shape") else "")
+              + f"] {len(pts)} pt(s): "
+              + " ".join(fmt(v) for _ts, v in shown))
+
+
+def cmd_debug_bundle(client, args) -> None:
+    """Capture a black-box post-mortem bundle of everything the session
+    knows (metrics history, events, stacks, flight recorder, access
+    logs, spans, memory ledger, config) into one portable tar."""
+    import time as _time
+
+    from .._private import debug_bundle
+    out = args.output or os.path.abspath(
+        f"rtpu_bundle_manual_{int(_time.time())}.tar.gz")
+    path = debug_bundle.capture(out, debug_bundle.ClientSource(client),
+                                reason="manual",
+                                timeout_s=args.timeout)
+    print(f"wrote {path} (inspect with `rtpu autopsy {path}`)")
+
+
+def cmd_autopsy(args) -> None:
+    """Offline post-mortem: replay a captured bundle through the
+    doctor/serve/coll-debug/memory surfaces with NO live cluster."""
+    from .._private import debug_bundle
+    bundle = debug_bundle.load(args.bundle)
+    rep = debug_bundle.build_autopsy(bundle,
+                                     trend_window=args.trend)
+    if args.format == "json":
+        print(json.dumps(rep, default=str, indent=2))
+        return
+    man = rep["manifest"]
+    import time as _time
+    created = _time.strftime("%Y-%m-%d %H:%M:%S", _time.localtime(
+        man.get("created_ts") or 0))
+    print(f"bundle: {args.bundle}")
+    print(f"  captured {created} (reason: {man.get('reason')}, "
+          f"format v{man.get('format_version')}, "
+          f"{len(man.get('sections') or [])} section(s))")
+    bad = [s["name"] for s in man.get("sections") or []
+           if not s.get("ok")]
+    if bad:
+        print(f"  ! sections that failed capture: {', '.join(bad)}")
+    trigger = rep.get("trigger") or {}
+    extra = {k: v for k, v in trigger.items() if k != "reason"}
+    if extra:
+        print("  trigger: " + ", ".join(f"{k}={v}"
+                                        for k, v in sorted(extra.items())))
+    print("\n== doctor (replayed offline) ==")
+    _render_doctor(rep["doctor"])
+    coll = rep.get("collectives") or {}
+    if coll.get("ops") or coll.get("verdicts"):
+        print("\n== collectives ==")
+        _render_coll(coll)
+    serve = rep.get("serve") or {}
+    if serve.get("deployments"):
+        print("\n== serve ==")
+        _render_serve(serve)
+    mem = rep.get("memory") or {}
+    if mem.get("total_objects"):
+        print("\n== memory ==")
+        _render_memory_summary(mem, mem.get("group_by", "callsite"),
+                               20, mem.get("sort_by", "bytes"))
+        _render_memory_leaks(mem)
+    stats = rep.get("events_stats") or {}
+    if stats.get("evicted"):
+        print(f"\n({stats['evicted']} event(s) had already been evicted "
+              "from the ring before capture)")
 
 
 def cmd_start(args) -> None:
@@ -634,6 +822,55 @@ def main(argv=None) -> None:
                            "replica table")
     p_srv.add_argument("--format", choices=("table", "json"),
                        default="table")
+    p_srv.add_argument("--trend", type=float, default=None,
+                       metavar="SECONDS",
+                       help="attach head/tail movement over this "
+                       "trailing history window")
+    p_ev = sub.add_parser("events",
+                          help="structured cluster events with "
+                          "--since/--until time windows")
+    p_ev.add_argument("--since", default=None,
+                      help="epoch seconds or relative (120s / 5m / 1h)")
+    p_ev.add_argument("--until", default=None,
+                      help="epoch seconds or relative (120s / 5m / 1h)")
+    p_ev.add_argument("--label", default=None)
+    p_ev.add_argument("--severity", default=None,
+                      choices=("DEBUG", "INFO", "WARNING", "ERROR"))
+    p_ev.add_argument("--limit", type=int, default=100)
+    p_ev.add_argument("--format", choices=("text", "json"),
+                      default="text")
+    p_hist = sub.add_parser("history",
+                            help="windowed metric time series from the "
+                            "retention ring (rate/delta shaping)")
+    p_hist.add_argument("metric", nargs="?", default=None,
+                        help="metric name (default: all retained)")
+    p_hist.add_argument("--window", type=float, default=None,
+                        help="trailing seconds (default: finest ring)")
+    p_hist.add_argument("--step", type=float, default=None,
+                        help="minimum seconds per point")
+    p_hist.add_argument("--shape", choices=("value", "rate", "delta"),
+                        default="value")
+    p_hist.add_argument("--limit", type=int, default=40,
+                        help="series shown (text format)")
+    p_hist.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    p_bundle = sub.add_parser("debug-bundle",
+                              help="capture a black-box post-mortem "
+                              "bundle (one portable tar)")
+    p_bundle.add_argument("-o", "--output", default=None)
+    p_bundle.add_argument("--timeout", type=float, default=2.0,
+                          help="per-fan-out budget (stacks, "
+                          "flight records)")
+    p_autopsy = sub.add_parser("autopsy",
+                               help="replay a captured bundle offline: "
+                               "doctor/serve/coll-debug/memory with no "
+                               "live cluster")
+    p_autopsy.add_argument("bundle", help="path to a debug-bundle tar")
+    p_autopsy.add_argument("--trend", type=float, default=None,
+                           metavar="SECONDS",
+                           help="trend window for the replayed doctor")
+    p_autopsy.add_argument("--format", choices=("text", "json"),
+                           default="text")
     p_req = sub.add_parser("requests",
                            help="recent serve access-log rows "
                            "(request ids, latency, queue wait)")
@@ -689,6 +926,10 @@ def main(argv=None) -> None:
     if args.command == "lint":
         cmd_lint(args)
         return
+    if args.command == "autopsy":
+        # offline by design: reads only the bundle, never a session
+        cmd_autopsy(args)
+        return
     if args.command == "start":
         cmd_start(args)
         return
@@ -716,7 +957,10 @@ def main(argv=None) -> None:
          "profile": cmd_profile, "doctor": cmd_doctor,
          "coll-debug": cmd_coll_debug,
          "serve-status": cmd_serve_status,
-         "requests": cmd_requests}[args.command](
+         "requests": cmd_requests,
+         "events": cmd_events,
+         "history": cmd_history,
+         "debug-bundle": cmd_debug_bundle}[args.command](
              client, args)
     finally:
         try:

@@ -33,6 +33,7 @@ class ServeController:
         # stragglers); once the process is actually dead a re-delete
         # is strictly the newest write and retires the series for good
         self._retire_queue: List[tuple] = []
+        self._last_replica_health = 0.0
         self._autoscale_thread = threading.Thread(
             target=self._autoscale_loop, daemon=True)
         self._autoscale_thread.start()
@@ -133,24 +134,88 @@ class ServeController:
                 # serve_health's sum/table — and every raw gauge
                 # surface (Prometheus scrape, dashboard, summary) —
                 # forget the dead replica instead of reporting its
-                # last value forever (a replica that CRASHES rather
-                # than being stopped is the open replica-death gap of
-                # ROADMAP item 5)
-                from . import replica as rep
-                from .._private import telemetry
-                tags = (("deployment", name or "default"),
-                        ("replica", tag))
-                telemetry.gauge_delete(rep.M_SERVE_QUEUE_DEPTH, tags)
-                with self._lock:
-                    self._retire_queue.append(
-                        (time.time() + 1.0,
-                         rep.M_SERVE_QUEUE_DEPTH, tags))
+                # last value forever
+                self._retire_replica_series(name, tag)
         if zeroed:
             # ship the zeros NOW: the controller itself may be killed
             # right after a delete (serve.shutdown), and the
             # rate-limited task-boundary flush could skip them
             from .._private import telemetry
             telemetry.flush()
+
+    def _retire_replica_series(self, name: str, tag: str) -> None:
+        """One replica's gauge series -> the delete/tombstone path (the
+        immediate marker plus a ~1s re-delete, see _retire_queue)."""
+        from . import replica as rep
+        from .._private import telemetry
+        tags = (("deployment", name or "default"), ("replica", tag))
+        telemetry.gauge_delete(rep.M_SERVE_QUEUE_DEPTH, tags)
+        with self._lock:
+            self._retire_queue.append(
+                (time.time() + 1.0, rep.M_SERVE_QUEUE_DEPTH, tags))
+
+    def _check_replica_health(self) -> None:
+        """Replica-DEATH observation (the PR-13 open gap): a replica
+        that CRASHES — rather than being scaled down — leaves its
+        queue-depth gauge series live forever, because only the
+        controlled stop path retired it. Poll the control plane's
+        actor table (~1/s, one STATE_QUERY) and route every dead
+        replica through the same gauge_delete/tombstone path, dropping
+        the dead handle so fan-outs stop paying its timeout. The
+        target count is untouched; the next reconcile/autoscale pass
+        decides whether to replace the capacity."""
+        now = time.time()
+        if now - self._last_replica_health < 1.0:
+            return
+        self._last_replica_health = now
+        from .._private import context as _ctx
+        client = _ctx.current_client
+        if client is None:
+            return
+        try:
+            rows = client.state_query("actors", None) or []
+        except Exception:   # noqa: BLE001 — health poll is best-effort
+            return
+        dead = set()
+        for r in rows:
+            if r.get("state") == "DEAD":
+                aid = r.get("actor_id")
+                dead.add(aid.hex() if hasattr(aid, "hex") else str(aid))
+        if not dead:
+            return
+        crashed: List[tuple] = []
+        with self._lock:
+            for name, rec in self._deployments.items():
+                tags = rec.setdefault("replica_tags", [])
+                keep_r: List[Any] = []
+                keep_t: List[Any] = []
+                for i, r in enumerate(rec["replicas"]):
+                    tag = tags[i] if i < len(tags) else None
+                    if r.actor_id.hex() in dead:
+                        crashed.append((name, tag))
+                    else:
+                        keep_r.append(r)
+                        keep_t.append(tag)
+                rec["replicas"] = keep_r
+                rec["replica_tags"] = keep_t
+        zeroed = False
+        for name, tag in crashed:
+            if tag is not None:
+                zeroed = True
+                self._retire_replica_series(name, tag)
+        if zeroed:
+            from .._private import telemetry
+            telemetry.flush()
+        # restore target capacity: the target count is unchanged, so a
+        # reconcile spawns replacements (fresh tags) — without this, a
+        # non-autoscaling deployment shrinks forever and an autoscaling
+        # one whose replicas ALL crashed is skipped by the autoscale
+        # loop's empty-replica guard and never recovers
+        for name in {n for n, _t in crashed}:
+            try:
+                self._reconcile(name)
+            except Exception:   # noqa: BLE001 — next pass retries
+                pass
 
     def _flush_retires(self) -> None:
         now = time.time()
@@ -169,6 +234,10 @@ class ServeController:
         while True:
             time.sleep(0.25)
             self._flush_retires()
+            try:
+                self._check_replica_health()
+            except Exception:   # noqa: BLE001 — observation only
+                pass
             with self._lock:
                 items = [(n, rec) for n, rec in self._deployments.items()
                          if rec.get("autoscaling")]

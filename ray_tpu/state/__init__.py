@@ -7,13 +7,17 @@ records straight from the control plane.
 """
 
 from .api import (  # noqa: F401
+    build_health_report,
     cluster_stacks,
     collective_health,
+    events_stats,
     flight_records,
     health_report,
     list_actors,
     list_cluster_events,
+    list_events,
     list_jobs,
+    list_lifecycle_events,
     list_metrics,
     list_nodes,
     list_objects,
@@ -21,6 +25,8 @@ from .api import (  # noqa: F401
     list_tasks,
     list_workers,
     memory_summary,
+    metrics_history,
+    metrics_trends,
     profile,
     serve_health,
     serve_requests,

@@ -269,9 +269,16 @@ def summarize_metrics(snap: Optional[dict] = None) -> Dict[str, Any]:
     ``ray summary``-style view of the telemetry table. Pass ``snap``
     to roll up an already-fetched snapshot (health_report fetches the
     cluster table once and shares it across its sections)."""
+    return summarize_metric_rows(shape_metrics(
+        snap if snap is not None else _query("metrics")))
+
+
+def summarize_metric_rows(rows: List[dict]) -> Dict[str, Any]:
+    """Pure row-based half of ``summarize_metrics`` — shared with the
+    offline bundle replay (``rtpu autopsy``), whose metrics arrive as
+    the JSON rows a bundle stores, not a live tuple-keyed snapshot."""
     out: Dict[str, Any] = {}
-    for row in shape_metrics(snap if snap is not None
-                             else _query("metrics")):
+    for row in rows or []:
         ent = out.setdefault(row["name"], {
             "kind": row["kind"], "description": row["description"],
             "series": 0})
@@ -322,8 +329,14 @@ def shape_serve_health(snap: Optional[dict]) -> Dict[str, Any]:
     per-replica table, and request/error totals. Shared by
     ``state.serve_health()``, the dashboard ``GET /api/serve`` (which
     reads the head's table with no client) and ``rtpu serve-status``."""
-    from .._private import telemetry as _tm
-    snap = snap or {}
+    return serve_health_from_rows(shape_metrics(snap))
+
+
+def serve_health_from_rows(rows: List[dict]) -> Dict[str, Any]:
+    """Row-based half of ``shape_serve_health``: consumes the JSON
+    series rows ``shape_metrics`` produces — which is exactly what a
+    debug bundle stores, so ``rtpu autopsy`` replays the serve surface
+    offline through this same function."""
     deps: Dict[str, dict] = {}
 
     def ent(name: str) -> dict:
@@ -337,44 +350,38 @@ def shape_serve_health(snap: Optional[dict]) -> Dict[str, Any]:
             }
         return d
 
-    for (name, tags), value in (snap.get("counters") or {}).items():
-        if name != "rtpu_serve_requests_total":
-            continue
-        t = dict(tags)
-        d = ent(t.get("deployment", "default"))
-        d["requests_total"] += value
-        if t.get("status") == "error":
-            d["errors_total"] += value
-    for (name, tags), (value, _ts) in (snap.get("gauges") or {}).items():
-        if name != "rtpu_serve_replica_queue_depth":
-            continue
-        if value != value or value < 0:
-            continue    # in-flight delete marker / defensive
-        t = dict(tags)
-        d = ent(t.get("deployment", "default"))
-        d["queue_depth"] += value
-        d["replicas"].append({"replica": t.get("replica", "0"),
-                              "queue_depth": value})
     digest_fields = {
         "rtpu_serve_request_latency_digest_seconds": "latency",
         "rtpu_serve_queue_wait_digest_seconds": "queue_wait",
         "rtpu_serve_batch_size_digest": "batch_size",
     }
-    for (name, tags), d in (snap.get("digests") or {}).items():
-        field = digest_fields.get(name)
-        if field is None:
-            continue
-        t = dict(tags)
-        rec = ent(t.get("deployment", "default"))
-        rec[field] = {
-            "p50": _tm.digest_quantile(d, 0.50),
-            "p95": _tm.digest_quantile(d, 0.95),
-            "p99": _tm.digest_quantile(d, 0.99),
-            "count": d.get("count", 0),
-            "mean": (d.get("sum", 0.0) / d["count"]
-                     if d.get("count") else 0.0),
-            "max": d.get("max"),
-        }
+    for row in rows or []:
+        name, t = row.get("name"), row.get("tags") or {}
+        if name == "rtpu_serve_requests_total":
+            d = ent(t.get("deployment", "default"))
+            d["requests_total"] += row.get("value") or 0.0
+            if t.get("status") == "error":
+                d["errors_total"] += row.get("value") or 0.0
+        elif name == "rtpu_serve_replica_queue_depth":
+            value = row.get("value")
+            if value is None or value != value or value < 0:
+                continue    # in-flight delete marker / defensive
+            d = ent(t.get("deployment", "default"))
+            d["queue_depth"] += value
+            d["replicas"].append({"replica": t.get("replica", "0"),
+                                  "queue_depth": value})
+        elif name in digest_fields:
+            q = row.get("quantiles") or {}
+            count = row.get("count") or 0
+            ent(t.get("deployment", "default"))[digest_fields[name]] = {
+                "p50": q.get("p50", 0.0),
+                "p95": q.get("p95", 0.0),
+                "p99": q.get("p99", 0.0),
+                "count": count,
+                "mean": ((row.get("sum") or 0.0) / count if count
+                         else 0.0),
+                "max": row.get("max"),
+            }
     worst = None
     for d in deps.values():
         d["replicas"].sort(key=lambda r: r["replica"])
@@ -389,11 +396,78 @@ def shape_serve_health(snap: Optional[dict]) -> Dict[str, Any]:
             "worst": worst[1] if worst else None}
 
 
-def serve_health() -> Dict[str, Any]:
+def shape_serve_trends(history_result: dict) -> Dict[str, Any]:
+    """Per-deployment movement over one windowed history query — the
+    exact ``trend=`` signal ROADMAP item 5's autoscaler consumes:
+    queue-depth head/tail means (summed over replicas), latency and
+    queue-wait p95 head/tail, and request rate head/tail. Pure (history
+    rows in, dict out) so the live ``serve_health(trend=)`` and the
+    offline autopsy share it."""
+    from .._private import history as _h
+    window = round(float(history_result.get("window_s") or 0.0))
+    out: Dict[str, dict] = {}
+
+    def ent(dep: str) -> dict:
+        d = out.get(dep)
+        if d is None:
+            d = out[dep] = {"deployment": dep, "window_s": window}
+        return d
+
+    def pair(head: float, tail: float) -> dict:
+        return {"head": round(head, 5), "tail": round(tail, 5),
+                "ratio": round(tail / head, 2) if head > 0 else None}
+
+    queue: Dict[str, List[float]] = {}
+    rate: Dict[str, List[float]] = {}
+    for s in history_result.get("series") or []:
+        name, tags = s["name"], s["tags"]
+        dep = tags.get("deployment")
+        if dep is None:
+            continue
+        if name == "rtpu_serve_replica_queue_depth":
+            h, t = _h._head_tail(_h.shape_points(s["points"], "value"))
+            queue.setdefault(dep, [0.0, 0.0])
+            queue[dep][0] += h
+            queue[dep][1] += t
+        elif name == "rtpu_serve_requests_total":
+            h, t = _h._head_tail(_h.shape_points(s["points"], "rate"))
+            rate.setdefault(dep, [0.0, 0.0])
+            rate[dep][0] += h
+            rate[dep][1] += t
+        elif name == "rtpu_serve_request_latency_digest_seconds":
+            pts = [[ts, v.get("p95", 0.0)] for ts, v in s["points"]
+                   if isinstance(v, dict) and v.get("count")]
+            h, t = _h._head_tail(pts)
+            ent(dep)["latency_p95"] = pair(h, t)
+        elif name == "rtpu_serve_queue_wait_digest_seconds":
+            pts = [[ts, v.get("p95", 0.0)] for ts, v in s["points"]
+                   if isinstance(v, dict) and v.get("count")]
+            h, t = _h._head_tail(pts)
+            ent(dep)["queue_wait_p95"] = pair(h, t)
+    for dep, (h, t) in queue.items():
+        ent(dep)["queue_depth"] = pair(h, t)
+    for dep, (h, t) in rate.items():
+        ent(dep)["request_rate"] = pair(h, t)
+    return out
+
+
+def serve_health(trend: Optional[float] = None) -> Dict[str, Any]:
     """Cluster-wide serving health: per-deployment latency/queue-wait/
     batch-size percentiles (from the streaming digests), queue depth,
-    error rate and the replica table (see ``shape_serve_health``)."""
-    return shape_serve_health(_query("metrics"))
+    error rate and the replica table (see ``shape_serve_health``).
+    ``trend=<seconds>`` additionally attaches per-deployment head/tail
+    movement over that retention window (queue depth, latency p95,
+    queue-wait p95, request rate) — the autoscaling signal with a time
+    axis."""
+    base = shape_serve_health(_query("metrics"))
+    if trend:
+        try:
+            hist = _query("metrics_history",
+                          {"window": float(trend)}) or {}
+        except Exception:   # noqa: BLE001 — trends degrade, never die
+            hist = {}
+        base["trend"] = shape_serve_trends(hist)
+    return base
 
 
 def serve_requests(limit: int = 100, slow: bool = False,
@@ -509,20 +583,122 @@ def flight_records(timeout_s: float = 2.0) -> dict:
     return _ctx.require_client().flight_records(timeout_s) or {}
 
 
+def metrics_history(name: Optional[str] = None,
+                    tags: Optional[dict] = None,
+                    window: Optional[float] = None,
+                    step: Optional[float] = None,
+                    shape: str = "value") -> Dict[str, Any]:
+    """Windowed time series from the control plane's multi-resolution
+    retention ring: aligned ``[ts, value]`` points per (name, tags)
+    series over the trailing ``window`` seconds, at the finest retained
+    resolution covering it (or the level nearest an explicit ``step``).
+    ``shape`` turns cumulative counter/histogram series into usable
+    curves: ``rate`` (per-second) or ``delta`` (per-step); gauges and
+    digest series (whose points already carry interval p50/p95/p99)
+    ignore it. Empty when ``metrics_history_capacity=0``."""
+    if shape not in ("value", "rate", "delta"):
+        raise ValueError(f"unknown shape {shape!r} (value | rate | delta)")
+    res = _query("metrics_history", {"name": name, "tags": tags,
+                                     "window": window, "step": step}) or {}
+    if shape != "value":
+        from .._private import history as _h
+        for s in res.get("series") or []:
+            if s.get("kind") in ("counter", "histogram"):
+                s["points"] = _h.shape_points(s["points"], shape)
+                s["shape"] = shape
+    return res
+
+
+def metrics_trends(window: float = 120.0) -> List[dict]:
+    """Named movements over the trailing window (the doctor's trend
+    section): rising watchlist gauges, serve p95 inflation, error-rate
+    growth, idle-node-while-queueing. Empty on a quiet cluster."""
+    from .._private import history as _h
+    res = _query("metrics_history", {"window": float(window)}) or {}
+    return _h.compute_trends(res)
+
+
+def list_lifecycle_events(limit: int = 10000,
+                          since: Optional[float] = None) -> List[dict]:
+    """Node/actor/placement-group state transitions retained past
+    death (bounded ring beside the task-event ring): what the cluster
+    was doing, even for subjects that no longer exist."""
+    rows = _query("lifecycle") or []
+    if since is not None:
+        rows = [r for r in rows if (r.get("ts") or 0) >= since]
+    return rows[-limit:]
+
+
+def events_stats() -> Dict[str, Any]:
+    """Cluster-event ring occupancy + the eviction counter behind
+    ``rtpu_events_evicted_total`` (silent history loss, observable)."""
+    return _query("events_stats") or {}
+
+
+_DOCTOR_TREND_WINDOW_S = 120.0
+
+
+def gather_health_data(trend_window: float = _DOCTOR_TREND_WINDOW_S
+                       ) -> Dict[str, Any]:
+    """Collect every input ``build_health_report`` consumes from the
+    live cluster, as JSON-able rows. Debug bundles store this same
+    shape section-by-section, so ``rtpu autopsy`` rebuilds the doctor
+    offline from a captured dict instead of live queries."""
+    client = _ctx.require_client()
+    data: Dict[str, Any] = {
+        "nodes": shape_nodes(client.cluster_info("nodes") or []),
+        "resources": {
+            "total": client.cluster_info("resources_total") or {},
+            "available": client.cluster_info("resources_available") or {},
+        },
+        "tasks": shape_tasks(_query("tasks")),
+        "actors": shape_actors(_query("actors")),
+        "events": _query("cluster_events") or [],
+    }
+    try:
+        data["collectives"] = collective_health(1.5) or {}
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        data["collectives"] = {}
+    try:
+        mem = _query("memory") or {}
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        mem = {}
+    data["memory"] = {"objects": shape_objects(mem.get("objects")),
+                      "leaks": shape_leaks(mem.get("leaks"))}
+    # ONE cluster-wide metrics snapshot, shared by the serve section
+    # and the telemetry highlights (two identical head RPCs otherwise)
+    try:
+        data["metrics"] = shape_metrics(_query("metrics"))
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        data["metrics"] = []
+    try:
+        data["history"] = _query("metrics_history",
+                                 {"window": float(trend_window)}) or {}
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        data["history"] = {}
+    return data
+
+
 def health_report() -> Dict[str, Any]:
     """`rtpu doctor`: one correlated cluster health view — node/resource
     state, task/actor rollups, stall diagnoses, recent WARNING/ERROR
-    events, and telemetry highlights (queue wait, store fill, dropped
-    series)."""
-    client = _ctx.require_client()
-    nodes = shape_nodes(client.cluster_info("nodes") or [])
-    total = client.cluster_info("resources_total") or {}
-    avail = client.cluster_info("resources_available") or {}
-    tasks = shape_tasks(_query("tasks"))
+    events, head-vs-tail trend movements over the retention window, and
+    telemetry highlights (queue wait, store fill, dropped series)."""
+    return build_health_report(gather_health_data())
+
+
+def build_health_report(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Pure doctor: consumes the ``gather_health_data`` dict — live or
+    replayed from a debug bundle (``rtpu autopsy``) with no cluster."""
+    from .._private import history as _history
+    nodes = data.get("nodes") or []
+    total = (data.get("resources") or {}).get("total") or {}
+    avail = (data.get("resources") or {}).get("available") or {}
+    tasks = data.get("tasks") or []
     task_summary = summarize_task_rows(tasks)
-    actor_rows = shape_actors(_query("actors"))
+    actor_rows = data.get("actors") or []
     actor_summary = summarize_actor_rows(actor_rows)
-    events = _query("cluster_events") or []
+    events = data.get("events") or []
     recent = events[-500:]
     # a stall is a problem only while its task is still non-terminal:
     # historical TASK_STALL events for tasks that since finished/failed
@@ -536,33 +712,29 @@ def health_report() -> Dict[str, Any]:
     alerts = [e for e in recent
               if e.get("severity") in ("WARNING", "ERROR")
               and e.get("label") != "TASK_STALL"]
-    try:
-        coll = collective_health(1.5)
-    except Exception:   # noqa: BLE001 — doctor degrades, never dies
-        coll = {}
+    coll = data.get("collectives") or {}
     coll_verdicts = coll.get("verdicts") or []
-    try:
-        mem = _query("memory") or {}
-    except Exception:   # noqa: BLE001 — doctor degrades, never dies
-        mem = {}
-    mem_rows = shape_objects(mem.get("objects"))
-    leaks = shape_leaks(mem.get("leaks"))
+    mem = data.get("memory") or {}
+    mem_rows = mem.get("objects") or []
+    leaks = mem.get("leaks") or []
 
     highlights: Dict[str, Any] = {}
-    # ONE cluster-wide metrics snapshot, shared by the serve section
-    # and the telemetry highlights (two identical head RPCs otherwise)
+    metric_rows = data.get("metrics") or []
     try:
-        metrics_snap = _query("metrics")
-    except Exception:   # noqa: BLE001 — doctor degrades, never dies
-        metrics_snap = None
-    try:
-        serve = shape_serve_health(metrics_snap)
+        serve = serve_health_from_rows(metric_rows)
     except Exception:   # noqa: BLE001 — doctor degrades, never dies
         serve = {"deployments": {}, "worst": None}
     try:
-        metrics = summarize_metrics(metrics_snap or {})
+        metrics = summarize_metric_rows(metric_rows)
     except Exception:   # noqa: BLE001 — doctor degrades, never dies
         metrics = {}
+    # trend section: head-vs-tail movements over the retention window
+    # ("what changed", not just "what is") — empty when the history
+    # plane is off or the window has no data
+    try:
+        trends = _history.compute_trends(data.get("history") or {})
+    except Exception:   # noqa: BLE001 — doctor degrades, never dies
+        trends = []
     queue_wait = metrics.get("rtpu_scheduler_queue_wait_seconds") or {}
     if queue_wait.get("count"):
         highlights["queue_wait_mean_s"] = round(
@@ -609,7 +781,9 @@ def health_report() -> Dict[str, Any]:
                  + by_state.get("PENDING_NODE_ASSIGNMENT", 0))
     problems: List[str] = []
     if dead_nodes:
-        problems.append(f"{len(dead_nodes)} node(s) dead")
+        named = ", ".join(str(n.get("node_id"))[:12]
+                          for n in dead_nodes[:4])
+        problems.append(f"{len(dead_nodes)} node(s) dead ({named})")
     if stalls:
         stalled = {e.get("task_id") for e in stalls}
         problems.append(f"{len(stalled)} stalled task(s) — see stalls")
@@ -641,9 +815,14 @@ def health_report() -> Dict[str, Any]:
                 f"deployment {worst_name!r} failing "
                 f"{wd['error_rate']:.0%} of {wd['requests_total']:g} "
                 "request(s) — see serve")
+    # movements are problems too: a queue-wait p95 3x-ing over the
+    # window is actionable before any instantaneous threshold trips
+    for t in [t for t in trends if t.get("severity") == "warn"][:5]:
+        problems.append(f"trend: {t['message']}")
     return {
         "healthy": not problems,
         "problems": problems,
+        "trends": trends,
         "nodes": {"alive": len(nodes) - len(dead_nodes),
                   "dead": len(dead_nodes)},
         "resources": {"total": total, "available": avail},
@@ -663,12 +842,31 @@ def health_report() -> Dict[str, Any]:
     }
 
 
-def list_cluster_events(filters: Optional[dict] = None,
-                        limit: int = 1000) -> List[dict]:
-    """Structured lifecycle events — node up/down, OOM kills, actor
-    deaths (reference: ``ray list cluster-events``)."""
+def list_events(filters: Optional[dict] = None,
+                limit: int = 1000,
+                since: Optional[float] = None,
+                until: Optional[float] = None) -> List[dict]:
+    """Structured cluster events — node up/down, OOM kills, actor
+    deaths, stalls, leaks (reference: ``ray list cluster-events``).
+    ``since``/``until`` are epoch-second bounds applied BEFORE the
+    limit, so a time window never loses older matching rows to the
+    cap; the ring's eviction counter (``rtpu_events_evicted_total`` /
+    ``state.events_stats()``) says whether rows aged out of retention
+    entirely."""
     rows = _query("cluster_events") or []
+    if since is not None:
+        rows = [r for r in rows if (r.get("timestamp") or 0) >= since]
+    if until is not None:
+        rows = [r for r in rows if (r.get("timestamp") or 0) <= until]
     return _apply_filters(rows, filters)[-limit:]
+
+
+def list_cluster_events(filters: Optional[dict] = None,
+                        limit: int = 1000,
+                        since: Optional[float] = None,
+                        until: Optional[float] = None) -> List[dict]:
+    """Alias of ``list_events`` (the reference-flavored name)."""
+    return list_events(filters, limit, since=since, until=until)
 
 
 def list_spans(filters: Optional[dict] = None,
@@ -812,14 +1010,38 @@ def _request_trace_events() -> List[dict]:
     return trace
 
 
-def timeline(filename: Optional[str] = None) -> Any:
+def lifecycle_trace_events(rows: List[dict]) -> List[dict]:
+    """Retained node/actor/PG state transitions as Chrome instant
+    events (``ph: "i"``, one lane per subject kind) — pure, shared by
+    ``timeline(lifecycle=True)`` and the offline autopsy replay."""
+    trace = []
+    for r in rows or []:
+        trace.append({
+            "name": f"{r.get('kind')}:{r.get('state')}",
+            "cat": "lifecycle",
+            "ph": "i",
+            "s": "g",       # global-scope instant marker
+            "ts": (r.get("ts") or 0) * 1e6,
+            "pid": f"lifecycle:{r.get('kind')}",
+            "tid": str(r.get("id"))[:12],
+            "args": {k: v for k, v in r.items()
+                     if k not in ("ts", "kind")},
+        })
+    return trace
+
+
+def timeline(filename: Optional[str] = None,
+             lifecycle: bool = False) -> Any:
     """Chrome-trace JSON of task execution (reference: ``ray.timeline``,
     ``_private/state.py:865``), plus one span per completed collective
     call from the flight recorder (``cat: collective``, one row per
     rank), plus one lane per traced serve request (``cat: request`` —
     ingress/queue-wait/batch-assembly/replica-execute and the
-    request's nested task spans, keyed by request id). Load the output
-    in chrome://tracing or Perfetto."""
+    request's nested task spans, keyed by request id).
+    ``lifecycle=True`` adds instant markers for retained node/actor/PG
+    state transitions (``cat: lifecycle``) so the trailing window shows
+    what the cluster was doing around each death. Load the output in
+    chrome://tracing or Perfetto."""
     events = _query("tasks") or []
     # pair RUNNING -> FINISHED/FAILED per task
     runs: Dict[Any, dict] = {}
@@ -845,6 +1067,11 @@ def timeline(filename: Optional[str] = None) -> Any:
             })
     trace.extend(_collective_trace_events())
     trace.extend(_request_trace_events())
+    if lifecycle:
+        try:
+            trace.extend(lifecycle_trace_events(_query("lifecycle")))
+        except Exception:   # noqa: BLE001 — timeline degrades, never dies
+            pass
     if filename is not None:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -7,6 +7,8 @@ Every node here is a real subprocess with its own GCS connection; the
 driver attaches by ``host:port``.
 """
 
+import json
+import os
 import time
 
 import numpy as np
@@ -884,3 +886,94 @@ def test_cross_node_request_trace_stitches(tcp_cluster):
         assert any(r["request_id"] == rid for r in rows), rows
     finally:
         serve.shutdown()
+
+
+def test_bundle_autopsy_after_node_death_chaos(tcp_cluster, tmp_path):
+    """ISSUE 14 acceptance: 2 OS-isolated nodes under queue-building
+    load; node B (hosting collective rank 1) is SIGKILLed; the driver's
+    ft_allreduce exhausts its reform budget (retries=0) on the
+    dead-rank verdict and AUTO-CAPTURES a black-box bundle. `rtpu
+    autopsy` — run offline against the tar, no session flag — then
+    reproduces the dead-node + dead-rank verdict AND the rising
+    queue-depth trend with no live cluster."""
+    import subprocess
+    import sys as _sys
+
+    from ray_tpu._private import debug_bundle
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.comm import collective as col
+
+    CONFIG._values["debug_bundle_dir"] = str(tmp_path)
+    CONFIG._values["collective_timeout_s"] = 6.0
+    debug_bundle._auto_captured.discard("collective_reform_exhausted")
+    victim = tcp_cluster.add_node(num_cpus=2, resources={"b": 2.0})
+    _wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=0, resources={"b": 1.0})
+    class Rank(col.CollectiveActorMixin):
+        def step(self, group):
+            col.allreduce(np.ones(4096, np.float32), group_name=group)
+            return True
+
+    m = Rank.remote()
+    join = m._rtpu_init_collective.remote(2, 1, "chaos14")
+    col.init_collective_group(2, 0, group_name="chaos14")
+    ray_tpu.get(join, timeout=60)
+
+    @ray_tpu.remote
+    def hog(i):
+        time.sleep(90)
+        return i
+
+    # queue-building load while a healthy collective loop runs: submit
+    # long tasks in waves so rtpu_scheduler_pending_tasks RISES across
+    # the retained window (the trend the autopsy must find offline)
+    hogs = [hog.remote(i) for i in range(4)]       # fill 4 CPUs
+    for wave in range(8):
+        hogs.extend(hog.remote(100 + wave * 10 + j) for j in range(4))
+        step_ref = m.step.remote("chaos14")
+        col.allreduce(np.ones(4096, np.float32), group_name="chaos14")
+        ray_tpu.get(step_ref, timeout=30)
+        time.sleep(1.0)
+
+    # SIGKILL node B: rank 1 dies with its whole node
+    tcp_cluster.remove_node(victim)
+    with pytest.raises(TimeoutError):
+        col.ft_allreduce(np.ones(4096, np.float32),
+                         group_name="chaos14", timeout=6.0, retries=0)
+
+    bundles = [f for f in os.listdir(tmp_path)
+               if f.startswith("rtpu_bundle_collective_reform_exhausted")]
+    assert bundles, ("reform-budget exhaustion did not auto-capture "
+                     f"a bundle in {tmp_path}")
+    bundle_path = os.path.join(tmp_path, bundles[0])
+
+    # OFFLINE autopsy: a fresh process, no --session, only the tar
+    out = subprocess.run(
+        [_sys.executable, "-m", "ray_tpu.scripts.cli", "autopsy",
+         bundle_path, "--format", "json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    # the dead node is named
+    assert rep["doctor"]["nodes"]["dead"] >= 1
+    dead_line = next(p for p in rep["doctor"]["problems"]
+                     if "node(s) dead" in p)
+    dead_rows = [n for n in ray_tpu.nodes() if not n["alive"]]
+    assert dead_rows
+    dead_hex = (dead_rows[0]["node_id"].hex()
+                if hasattr(dead_rows[0]["node_id"], "hex")
+                else str(dead_rows[0]["node_id"]))
+    assert dead_hex[:12] in dead_line
+    # the dead-rank verdict the survivors saw rides the capture trigger
+    assert rep["trigger"]["reason"] == "collective_reform_exhausted"
+    assert "dead rank 1" in rep["trigger"]["verdict"]
+    # the queue-depth trend is reproduced offline: pending tasks rose
+    # across the retained window
+    trend = [t for t in rep["doctor"]["trends"]
+             if t["metric"] == "rtpu_scheduler_pending_tasks"]
+    assert trend, rep["doctor"]["trends"]
+    assert trend[0]["tail"] > trend[0]["head"]
+    # and the raw history series is in the bundle for ad-hoc queries
+    hist_series = {s["name"] for s in rep["history"]["series"]}
+    assert "rtpu_scheduler_pending_tasks" in hist_series

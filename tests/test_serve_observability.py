@@ -376,3 +376,67 @@ def test_scale_down_zeroes_dead_replica_gauge(serve_session):
         return None
 
     assert _wait(settled, timeout=20), rstate.serve_health()
+
+
+def test_crashed_replica_gauge_retired(serve_session):
+    """ISSUE 14 satellite (the PR-13 open gap): a replica that CRASHES
+    — killed, not scaled down — must have its queue-depth gauge series
+    deleted too. The controller's ~1/s replica-death observation routes
+    the dead replica through the same gauge_delete/tombstone path the
+    controlled-stop path uses: after the kill, exactly the survivors'
+    rows remain in serve_health's replica table and queue sum."""
+
+    @serve.deployment(num_replicas=2)
+    class Crashy:
+        def __call__(self, x):
+            time.sleep(0.05)
+            return x
+
+    handle = serve.run(Crashy.bind())
+    # drive both replicas so both publish non-zero depths at some point
+    rs = [handle.remote(i) for i in range(8)]
+    assert sorted(r.result(timeout=20) for r in rs) == list(range(8))
+
+    def two_replicas():
+        dep = (rstate.serve_health().get("deployments") or {}).get(
+            "Crashy")
+        return (dep if dep and len(dep.get("replicas") or []) >= 2
+                else None)
+
+    assert _wait(two_replicas, timeout=20)
+
+    controller = ray_tpu.get_actor("rtpu:serve_controller")
+    replicas = ray_tpu.get(controller.get_replicas.remote("Crashy"))
+    assert len(replicas) == 2
+    survivor_rows = None
+    # CRASH (hard kill) one replica — no controlled-stop path runs
+    ray_tpu.kill(replicas[0])
+
+    def only_survivors():
+        dep = (rstate.serve_health().get("deployments") or {}).get(
+            "Crashy")
+        if not dep:
+            return None
+        rows = dep.get("replicas") or []
+        return dep if len(rows) == 1 else None
+
+    dep = _wait(only_survivors, timeout=25)
+    assert dep, rstate.serve_health()
+    survivor_rows = dep["replicas"]
+    # exactly the survivor's row remains — and the queue sum carries
+    # only its value (the dead replica's last depth is gone; the
+    # replacement publishes nothing until it is driven)
+    assert len(survivor_rows) == 1
+    assert dep["queue_depth"] == survivor_rows[0]["queue_depth"]
+
+    # the dead handle was dropped AND target capacity restored: the
+    # survivor plus a freshly-tagged replacement, never the corpse
+    def replaced():
+        left = ray_tpu.get(controller.get_replicas.remote("Crashy"))
+        ids = [r.actor_id for r in left]
+        return (left if (len(left) == 2
+                         and replicas[1].actor_id in ids
+                         and replicas[0].actor_id not in ids)
+                else None)
+
+    assert _wait(replaced, timeout=20)
