@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions
 from . import context as _ctx
+from . import fieldsan
 from . import locksan
 from . import protocol as P
 from . import telemetry
@@ -67,6 +68,7 @@ def _creator_label() -> str:
     return name if name else "driver"
 
 
+@fieldsan.guarded
 class CoreClient:
     def __init__(self, conn: P.Connection, job_id: JobID,
                  worker_id: WorkerID, kind: int):
@@ -165,6 +167,7 @@ class CoreClient:
                 self._prov_buf.append((oid, cs, creator))
         telemetry.counter_inc(telemetry.M_OBJ_CALLSITES, float(len(oids)))
 
+    # concurrency: requires(client.ref)
     def _apply_decrs_locked(self) -> None:
         while True:
             try:
@@ -267,26 +270,37 @@ class CoreClient:
             for msg in msgs:
                 self.handle_message(*msg)
 
+    def _take_future(self, req_id: int) -> Optional[Future]:
+        """Pop a reply future UNDER ``_req_lock``: the reader thread's
+        pop used to race ``_fail_all`` (conn teardown / send-error on
+        another thread), whose take-all-and-clear could hand the SAME
+        future to both sides — set_result after set_exception raises
+        InvalidStateError and killed the process's only reply-routing
+        loop. dict.pop alone looked atomic; the snapshot in _fail_all
+        is what made it a two-step race (found by fieldsan, ISSUE 15)."""
+        with self._req_lock:
+            return self._futures.pop(req_id, None)
+
     def handle_message(self, op: int, payload: Any) -> None:
         if op == P.PUT_REPLY:
             (req_id,) = payload
-            fut = self._futures.pop(req_id, None)
+            fut = self._take_future(req_id)
             if fut is not None:
                 fut.set_result(None)
         elif op in (P.GET_REPLY, P.KV_REPLY, P.NAMED_ACTOR_REPLY,
                     P.FUNCTION_REPLY, P.INFO_REPLY):
             req_id, value = payload
-            fut = self._futures.pop(req_id, None)
+            fut = self._take_future(req_id)
             if fut is not None:
                 fut.set_result(value)
         elif op == P.WAIT_REPLY:
             req_id, ready, pending = payload
-            fut = self._futures.pop(req_id, None)
+            fut = self._take_future(req_id)
             if fut is not None:
                 fut.set_result((ready, pending))
         elif op == P.ERROR_REPLY:
             req_id, err = payload
-            fut = self._futures.pop(req_id, None)
+            fut = self._take_future(req_id)
             if fut is not None:
                 fut.set_exception(ser.from_bytes(err))
         elif op == P.GEN_ACK:

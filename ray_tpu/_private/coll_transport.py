@@ -32,6 +32,7 @@ from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from . import context
+from . import fieldsan
 from . import flight_recorder
 from . import locksan
 from . import telemetry
@@ -279,3 +280,8 @@ def stats() -> Dict[str, int]:
     with _lock:
         out["pending"] = len(_slots)
     return out
+
+
+# guarded-by plane: wrap the declared module-level mailbox state in
+# checking proxies (no-op when RTPU_FIELDSAN is off)
+fieldsan.instrument_module(globals(), "coll_transport")

@@ -155,6 +155,23 @@ _CONFIG_DEFS: Dict[str, tuple] = {
                                    "ring buffer of structured cluster "
                                    "events (reference: event framework, "
                                    "src/ray/util/event.h)"),
+    "fieldsan": (bool, False,
+                 "guarded-by field sanitizer (fieldsan.py): instrument "
+                 "declared shared fields (locksan.FIELDS) and report "
+                 "cross-thread accesses whose write side does not hold "
+                 "the declared guard. Read once at import (descriptors "
+                 "install at class creation) — set in the environment, "
+                 "not _system_config; tier-1 conftest sets it"),
+    "fieldsan_mode": (str, "log",
+                      "fieldsan violation handling: 'log' records + "
+                      "prints with both stacks; 'raise' refuses the "
+                      "access with FieldRaceViolation before a write "
+                      "applies"),
+    "fieldsan_sample": (int, 16,
+                        "capture a stack on 1-in-N guard-held accesses "
+                        "(unguarded accesses always capture); higher = "
+                        "cheaper instrumented path, sparser 'other "
+                        "side' stacks in reports"),
     "tracing_enabled": (bool, False,
                         "record spans around task submission/execution "
                         "with cross-process context propagation "

@@ -18,6 +18,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import fieldsan
 from . import history as history_mod
 from . import locksan
 from . import telemetry
@@ -174,6 +175,7 @@ class _CompactingStorage:
         self._inner.close()
 
 
+@fieldsan.guarded
 class GlobalControlPlane:
     """Thread-safe cluster-wide registries.
 
@@ -313,6 +315,7 @@ class GlobalControlPlane:
         self._restore()
 
     # ------------------------------------------------------- persistence
+    # concurrency: requires(gcs.plane)
     def _restore(self) -> None:
         """Replay the journal into the durable tables (no-op in-memory)."""
         for table, op, payload in self._storage.load():
@@ -664,6 +667,7 @@ class GlobalControlPlane:
             self._purge_stale_pending_pgs()
             return [dict(rec) for rec in self.pending_pgs.values()]
 
+    # concurrency: requires(gcs.plane)
     def _purge_stale_pending_pgs(self) -> None:
         cutoff = time.time() - self.PENDING_PG_TTL_S
         for pg_id in [p for p, rec in self.pending_pgs.items()
@@ -778,6 +782,7 @@ class GlobalControlPlane:
                 self._schedule_zero_locked(oid)
         self.sweep_ref_zeros()
 
+    # concurrency: requires(gcs.plane)
     def _schedule_zero_locked(self, oid: ObjectID) -> None:
         """Count hit zero: schedule the free after a short grace window
         instead of freeing now. A ref travelling between processes (a
@@ -833,6 +838,7 @@ class GlobalControlPlane:
             self._unpin_locked(task_id)
         self.sweep_ref_zeros()
 
+    # concurrency: requires(gcs.plane)
     def _unpin_locked(self, task_id: TaskID) -> None:
         self._task_pin_owner.pop(task_id, None)
         for oid in self._task_arg_refs.pop(task_id, ()):
@@ -862,6 +868,7 @@ class GlobalControlPlane:
                 return
             self._pin_contained_locked(holder_oid, oids)
 
+    # concurrency: requires(gcs.plane)
     def _pin_contained_locked(self, holder_oid: ObjectID,
                               oids: List[ObjectID]) -> None:
         self._release_contained_locked(holder_oid)
@@ -870,6 +877,7 @@ class GlobalControlPlane:
             self.ref_pins[oid] = self.ref_pins.get(oid, 0) + 1
             self._zero_pending.pop(oid, None)
 
+    # concurrency: requires(gcs.plane)
     def _release_contained_locked(self, holder_oid: ObjectID) -> None:
         self._contained_pending.pop(holder_oid, None)
         for oid in self._contained_pins.pop(holder_oid, ()):
@@ -880,6 +888,7 @@ class GlobalControlPlane:
             else:
                 self.ref_pins[oid] = n
 
+    # concurrency: requires(gcs.plane)
     def _zero_check(self, oid: ObjectID):
         """Callers hold _lock. Returns a REF_ZERO payload when the object
         became garbage: it was tracked, no process holds a ref, and no
@@ -1396,6 +1405,7 @@ class GlobalControlPlane:
                     "evicted": self._events_evicted}
 
     # -------------------------------------------- lifecycle transitions
+    # concurrency: requires(gcs.plane)
     def _record_lifecycle_locked(self, kind: str, ident: str, state: str,
                                  **fields) -> None:
         rec = {"kind": kind, "id": ident, "state": state,
@@ -1421,6 +1431,7 @@ class GlobalControlPlane:
             return list(self.spans)[-limit:]
 
     # ------------------------------------------------------------ metrics
+    # concurrency: requires(gcs.plane)
     def _metric_series_ok(self, table: dict, key: tuple) -> bool:
         """Series-cardinality cap: a runaway tag (e.g. a per-request id)
         must not grow the head without bound."""

@@ -28,6 +28,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from . import fieldsan
 from . import telemetry
 
 M_HISTORY_BYTES = telemetry.define(
@@ -67,6 +68,7 @@ class _Frame:
                              for d in digests.values()))
 
 
+@fieldsan.guarded
 class _Level:
     __slots__ = ("step", "capacity", "frames", "last_ts",
                  "pending_digests")
@@ -98,6 +100,7 @@ def _parse_resolutions(steps: str, capacity: int) -> List[Tuple[float, int]]:
             for i, s in enumerate(parsed)]
 
 
+@fieldsan.guarded
 class MetricsHistory:
     """Multi-resolution frame rings. NOT internally locked — the owning
     control plane serializes access under its own lock."""
@@ -111,6 +114,7 @@ class MetricsHistory:
         self.frames_evicted = 0
 
     # ------------------------------------------------------------ record
+    # concurrency: requires(gcs.plane)
     def record(self, ts: float, counters: dict, gauges: dict,
                hists: dict, interval_digests: dict) -> int:
         """Append one snapshot instant. ``counters``/``gauges``/``hists``
@@ -151,6 +155,7 @@ class MetricsHistory:
             self._evict(level)
         return self.total_bytes
 
+    # concurrency: requires(gcs.plane)
     def _evict(self, level: _Level) -> None:
         frame = level.frames.popleft()
         self.total_bytes -= frame.nbytes

@@ -159,10 +159,288 @@ REGISTRY: Dict[str, tuple] = {
                         "one metrics shard (8 instances)"),
 }
 
+# ----------------------------------------------------------- guarded-by
+#
+# FIELDS: declared shared-state ownership — which guard protects each
+# multi-thread-touched attribute (the data-side complement of REGISTRY,
+# reference: Clang GUARDED_BY annotations across src/ray/common/).
+# Key: "<module short name>.<Class>.<attr>" for instance fields,
+# "<module short name>.<name>" for module-level state. Value:
+#
+#   "<lock name>"        guarded by that REGISTRY lock (reads+writes
+#                        pair-checked at runtime; every lexical write
+#                        must sit under `with <lock>` or a
+#                        `# concurrency: requires(<lock>)` function —
+#                        rule (h) of scripts/check_concurrency.py)
+#   "thread:<pat>"       write-confined to threads whose name contains
+#                        <pat>; cross-thread reads are tolerated dirty
+#                        reads (GIL-atomic), a foreign write is a
+#                        violation
+#   "<lock name>|static" guarded by that lock, verified by the STATIC
+#                        pass only — the documented hot-path exemption
+#                        (per-message transport innards, metric shards)
+#                        where a per-access runtime hook costs more
+#                        than the residual risk of the small audited
+#                        module it guards
+#   "atomic:<reason>"    deliberately lock-free shared state relying on
+#                        GIL-atomic single ops; declared so the
+#                        undeclared-candidate inference can't rot, not
+#                        instrumented
+#
+# Runtime checking lives in _private/fieldsan.py (RTPU_FIELDSAN=1, on
+# in tier-1); classes/modules opt in with @fieldsan.guarded /
+# fieldsan.instrument_module, which rule (h) verifies. DESIGN.md
+# "Shared-state ownership map" mirrors this table (cross-checked both
+# directions).
+
+FIELDS: Dict[str, str] = {
+    # --- control plane: every registry/table under the one plane lock
+    "gcs.GlobalControlPlane.nodes": "gcs.plane",
+    "gcs.GlobalControlPlane.actors": "gcs.plane",
+    "gcs.GlobalControlPlane.named_actors": "gcs.plane",
+    "gcs.GlobalControlPlane.jobs": "gcs.plane",
+    "gcs.GlobalControlPlane.kv": "gcs.plane",
+    "gcs.GlobalControlPlane.placement_groups": "gcs.plane",
+    "gcs.GlobalControlPlane.directory": "gcs.plane",
+    "gcs.GlobalControlPlane.gen_streams": "gcs.plane",
+    "gcs.GlobalControlPlane.pending_pgs": "gcs.plane",
+    "gcs.GlobalControlPlane.task_events": "gcs.plane",
+    "gcs.GlobalControlPlane.cluster_events": "gcs.plane",
+    "gcs.GlobalControlPlane.lifecycle_events": "gcs.plane",
+    "gcs.GlobalControlPlane._events_evicted": "gcs.plane",
+    "gcs.GlobalControlPlane._history_interval_digests": "gcs.plane",
+    "gcs.GlobalControlPlane._history_last": "gcs.plane",
+    "gcs.GlobalControlPlane.spans": "gcs.plane",
+    "gcs.GlobalControlPlane.metrics_counters": "gcs.plane",
+    "gcs.GlobalControlPlane.metrics_gauges": "gcs.plane",
+    "gcs.GlobalControlPlane._gauge_tombstones": "gcs.plane",
+    "gcs.GlobalControlPlane.metrics_hists": "gcs.plane",
+    "gcs.GlobalControlPlane.metrics_digests": "gcs.plane",
+    "gcs.GlobalControlPlane.metrics_meta": "gcs.plane",
+    "gcs.GlobalControlPlane._metrics_dropped_keys": "gcs.plane",
+    "gcs.GlobalControlPlane._metrics_conflict_keys": "gcs.plane",
+    "gcs.GlobalControlPlane._subscribers": "gcs.plane",
+    "gcs.GlobalControlPlane.ref_holders": "gcs.plane",
+    "gcs.GlobalControlPlane.ref_pins": "gcs.plane",
+    "gcs.GlobalControlPlane._task_arg_refs": "gcs.plane",
+    "gcs.GlobalControlPlane._task_pin_owner": "gcs.plane",
+    "gcs.GlobalControlPlane._freed_early": "gcs.plane",
+    "gcs.GlobalControlPlane._contained_pins": "gcs.plane",
+    "gcs.GlobalControlPlane._contained_pending": "gcs.plane",
+    "gcs.GlobalControlPlane._zero_pending": "gcs.plane",
+    "gcs.GlobalControlPlane.lineage": "gcs.plane",
+    "gcs.GlobalControlPlane._lineage_live": "gcs.plane",
+    "gcs.GlobalControlPlane._lineage_bytes": "gcs.plane",
+    "gcs.GlobalControlPlane._sealed_once": "gcs.plane",
+    "gcs.GlobalControlPlane._reconstruct_claims": "gcs.plane",
+    "gcs.GlobalControlPlane._reconstruct_counts": "gcs.plane",
+    "gcs.GlobalControlPlane.actor_checkpoints": "gcs.plane",
+    "gcs.GlobalControlPlane._actor_reroutes": "gcs.plane",
+    "gcs.GlobalControlPlane._stall_last_sweep": "gcs.plane",
+    "gcs.GlobalControlPlane._stall_warned": "gcs.plane",
+    "gcs.GlobalControlPlane.obj_provenance": "gcs.plane",
+    "gcs.GlobalControlPlane._leaks": "gcs.plane",
+    "gcs.GlobalControlPlane._pinned_zero_since": "gcs.plane",
+    "gcs.GlobalControlPlane._leak_warned": "gcs.plane",
+    "gcs.GlobalControlPlane._leak_last_sweep": "gcs.plane",
+    "gcs.GlobalControlPlane._storage": "gcs.plane",
+    # --- metrics-history rings: owned by the plane, serialized under
+    # --- its lock (standalone instances in unit tests are
+    # --- single-threaded; the live plane routes queries through
+    # --- gcs.metrics_history_query)
+    "history.MetricsHistory.levels": "gcs.plane",
+    "history.MetricsHistory.total_bytes": "gcs.plane",
+    "history.MetricsHistory.frames_evicted": "gcs.plane",
+    "history._Level.frames": "gcs.plane",
+    "history._Level.last_ts": "gcs.plane",
+    "history._Level.pending_digests": "gcs.plane",
+    # --- per-process client (CoreClient)
+    "client.CoreClient._futures": "client.req",
+    "client.CoreClient._next_req": "client.req",
+    "client.CoreClient._ref_counts": "client.ref|static",
+    "client.CoreClient._edge_buf": "client.ref|static",
+    "client.CoreClient._prov_buf": "client.ref|static",
+    "client.CoreClient._sub_buf": "client.sub|static",
+    "client.CoreClient._gen_credit": "client.gen_credit",
+    "client.CoreClient._pending_decrs":
+        "atomic:GC-safe lock-free deque — ObjectRef.__del__ may run "
+        "while this thread already holds client.ref",
+    "client.CoreClient._registered_fns":
+        "atomic:set add/membership are GIL-atomic; a duplicate "
+        "registration is an idempotent KV_PUT",
+    # --- node service: ONE dispatcher thread owns the scheduling state
+    "node.NodeService._pending": "thread:rtpu-dispatch",
+    "node._PendingQueue._by_shape": "thread:rtpu-dispatch",
+    "node.NodeService._workers": "thread:rtpu-dispatch",
+    "node.NodeService._idle": "thread:rtpu-dispatch",
+    "node.NodeService._num_starting": "thread:rtpu-dispatch",
+    "node.NodeService._env_spawn_failures": "thread:rtpu-dispatch",
+    "node.NodeService._env_spawn_error": "thread:rtpu-dispatch",
+    "node.NodeService._exec_outbox": "thread:rtpu-dispatch",
+    "node.NodeService._reply_outbox": "thread:rtpu-dispatch",
+    "node.NodeService._in_batch": "thread:rtpu-dispatch",
+    "node.NodeService._route_debits": "thread:rtpu-dispatch",
+    "node.NodeService._node_versions": "thread:rtpu-dispatch",
+    "node.NodeService._task_origin": "thread:rtpu-dispatch",
+    "node.NodeService._waiting_deps": "thread:rtpu-dispatch",
+    "node.NodeService._dep_index": "thread:rtpu-dispatch",
+    "node.NodeService._running": "thread:rtpu-dispatch",
+    "node.NodeService._owned": "thread:rtpu-dispatch",
+    "node.NodeService._actors": "thread:rtpu-dispatch",
+    "node.NodeService._actor_queues": "thread:rtpu-dispatch",
+    "node.NodeService._actor_blocked_owners": "thread:rtpu-dispatch",
+    "node.NodeService._get_waiters": "thread:rtpu-dispatch",
+    "node.NodeService._wait_waiters": "thread:rtpu-dispatch",
+    "node.NodeService._gen_waiters": "thread:rtpu-dispatch",
+    "node.NodeService._gen_consumed_cache": "thread:rtpu-dispatch",
+    "node.NodeService._gen_local": "thread:rtpu-dispatch",
+    "node.NodeService._obj_waiter_index": "thread:rtpu-dispatch",
+    "node.NodeService._next_waiter": "thread:rtpu-dispatch",
+    "node.NodeService._infeasible": "thread:rtpu-dispatch",
+    "node.NodeService._repark_deadline": "thread:rtpu-dispatch",
+    "node.NodeService._conn_refs": "thread:rtpu-dispatch",
+    "node.NodeService._reconstructing": "thread:rtpu-dispatch",
+    "node.NodeService._reroute_parked": "thread:rtpu-dispatch",
+    "node.NodeService._conn_kind": "thread:rtpu-dispatch",
+    "node.NodeService._conn_worker": "thread:rtpu-dispatch",
+    "node.NodeService._conn_coll_wid": "thread:rtpu-dispatch",
+    "node.NodeService._coll_conns": "thread:rtpu-dispatch",
+    "node.NodeService._driver_conn_keys": "thread:rtpu-dispatch",
+    # tick-thread-confined heartbeat state
+    "node.NodeService._last_hb_at": "thread:rtpu-tick",
+    "node.NodeService._hb_count": "thread:rtpu-tick",
+    "node.NodeService._resource_version": "thread:rtpu-tick",
+    "node.NodeService._last_hb_snapshot": "thread:rtpu-tick",
+    "node.NodeService._last_hb_pending": "thread:rtpu-tick",
+    # resource accounting under node.res
+    "node.NodeService.resources_available": "node.res",
+    "node.NodeService.pg_reservations": "node.res",
+    "node.NodeService.pg_bundle_total": "node.res",
+    "node.NodeService._tpu_free": "node.res",
+    # debug-collection futures under node.debug
+    "node.NodeService._debug_futures": "node.debug",
+    "node.NodeService._next_debug_token": "node.debug",
+    # deliberately lock-free node state
+    "node.NodeService._conns":
+        "atomic:unique-key inserts from the two accept threads, pops "
+        "on the dispatcher; dict ops are GIL-atomic",
+    "node.NodeService._coll_peers":
+        "atomic:idempotent same-value cache fill from reader threads "
+        "(chunk forwarding must not pay a lock per chunk)",
+    "node.NodeService._peers":
+        "atomic:idempotent cache fill; readers revalidate via each "
+        "peer's closed/dead flag",
+    "node.NodeService._coll_health_cache":
+        "atomic:racy TTL cache — a tuple swap; duplicate diagnosis "
+        "fan-outs are the only cost of a lost race",
+    # --- worker runtime: exec-thread-confined actor state; the rest is
+    # --- deliberately lock-free reader<->exec signalling
+    "worker.WorkerRuntime._actor_instance": "thread:task-exec",
+    "worker.WorkerRuntime._actor_spec": "thread:task-exec",
+    "worker.WorkerRuntime._pool": "thread:task-exec",
+    "worker.WorkerRuntime._aio_loop": "thread:task-exec",
+    "worker.WorkerRuntime._current_task_thread": "thread:task-exec",
+    "worker.WorkerRuntime._functions":
+        "atomic:idempotent cache fill; concurrent actor pool threads "
+        "may each load the same function blob once",
+    "worker.WorkerRuntime._cancelled_queued":
+        "atomic:reader thread adds, exec thread discards; set ops are "
+        "GIL-atomic and a missed cancel re-runs the cancel path",
+    "worker.WorkerRuntime._blocked_in_get":
+        "atomic:bool flag written by the exec thread, read by the "
+        "reader's bounce check — a stale read only delays one bounce",
+    "worker.WorkerRuntime._ckpt_counter":
+        "atomic:itertools.count allocation is GIL-atomic; overlapping "
+        "re-seeds are benign (documented in checkpoint_now)",
+    "worker.WorkerRuntime._ckpt_calls":
+        "atomic:periodic-trigger counter; a lost increment delays one "
+        "checkpoint by one call",
+    "worker.WorkerRuntime._ckpt_last_t":
+        "atomic:periodic-trigger stamp, same tolerance as _ckpt_calls",
+    "worker.WorkerRuntime._kicker":
+        "atomic:benign duplicate kicker if two completions race the "
+        "first _ensure_kicker; both just kick the same conn",
+    # --- collective chunk mailbox (module-level, under coll.mailbox)
+    "coll_transport._slots": "coll.mailbox",
+    "coll_transport._born": "coll.mailbox",
+    "coll_transport._fenced": "coll.mailbox",
+    "coll_transport._next_sweep": "coll.mailbox",
+    "coll_transport._stats":
+        "atomic:per-field single-writer counters (rank thread / reader "
+        "thread); dict slot += is the documented tolerance",
+    # --- telemetry shards + runtime registry
+    "telemetry._Digest.cents":
+        "atomic:a digest instance is owned by its containing table's "
+        "lock (telemetry.shard live, gcs.plane on the merge path); "
+        "never shared across owners",
+    "telemetry._Digest.buf": "atomic:see telemetry._Digest.cents",
+    "telemetry._Digest.count": "atomic:see telemetry._Digest.cents",
+    "telemetry._Digest.sum": "atomic:see telemetry._Digest.cents",
+    "telemetry._Digest.min": "atomic:see telemetry._Digest.cents",
+    "telemetry._Digest.max": "atomic:see telemetry._Digest.cents",
+    "telemetry._Shard.counters": "telemetry.shard|static",
+    "telemetry._Shard.gauges": "telemetry.shard|static",
+    "telemetry._Shard.gauges_dirty": "telemetry.shard|static",
+    "telemetry._Shard.hists": "telemetry.shard|static",
+    "telemetry._Shard.digests": "telemetry.shard|static",
+    "telemetry._meta": "telemetry.meta",
+    "telemetry._conflict_warned": "telemetry.meta",
+    "telemetry._nodes": "telemetry.runtime",
+    "telemetry._flusher_started":
+        "atomic:double-checked flag — probed lock-free, set under "
+        "telemetry.runtime",
+    "telemetry._sampler_started":
+        "atomic:set-once under telemetry.runtime, probed lock-free",
+    "telemetry._last_flush":
+        "atomic:rate-limiter stamp; a lost update costs one extra flush",
+    "telemetry._last_digest_ship":
+        "atomic:rate-limiter stamp for the digest ship cadence",
+    "telemetry._digest_gen":
+        "atomic:generation bump on reset(); handles re-resolve on "
+        "mismatch",
+    "telemetry._jax_listener_installed":
+        "atomic:set-once latch; a duplicate listener install is "
+        "idempotent at the jax API",
+    # --- object store
+    "object_store.ObjectStore._entries": "store.entries|static",
+    "object_store.ObjectStore._used": "store.entries|static",
+    "object_store.ObjectStore._quarantine": "store.entries",
+    "object_store.ObjectStore.num_spilled": "store.entries",
+    "object_store.ObjectStore.num_restored": "store.entries",
+    "object_store.ObjectReader._segments": "store.reader_segments",
+    # --- transport (protocol.Connection)
+    "protocol.Connection._outq": "conn.queue|static",
+    "protocol.Connection._broken": "conn.queue|static",
+    "protocol.Connection._closing": "conn.queue|static",
+    "protocol.Connection._recv_buf":
+        "atomic:single reader per connection by construction (the "
+        "owning process's one recv loop)",
+    "protocol.Connection._decoded": 
+        "atomic:single reader per connection by construction (decode "
+        "buffer of the owning process's one recv loop)",
+    "protocol.Connection._oob_scratch":
+        "atomic:owned by the active drainer (conn.flush held via the "
+        "explicit combining-drainer acquire, invisible to the "
+        "with-block pass)",
+    "protocol.Connection._stat_flushes":
+        "atomic:drainer-owned flush counters, published every 64 "
+        "flushes; conn.flush is held via explicit acquire",
+    "protocol.Connection._stat_msgs":
+        "atomic:drainer-owned, see _stat_flushes",
+    "protocol.Connection._stat_bytes":
+        "atomic:drainer-owned, see _stat_flushes",
+    "protocol.Connection._stat_oob":
+        "atomic:drainer-owned, see _stat_flushes",
+}
+
 # ------------------------------------------------------------- plumbing
 
-_ENABLED = os.environ.get("RTPU_LOCKSAN", "").lower() in ("1", "true",
-                                                          "yes", "on")
+# Fieldsan (RTPU_FIELDSAN) needs the held-lock bookkeeping the _SanLock
+# wrappers maintain, so either sanitizer env enables the wrappers; the
+# order/hierarchy checks stay coupled (they are accurate and cheap).
+_ENABLED = any(
+    os.environ.get(var, "").lower() in ("1", "true", "yes", "on")
+    for var in ("RTPU_LOCKSAN", "RTPU_FIELDSAN"))
 _MODE = os.environ.get("RTPU_LOCKSAN_MODE", "log")
 
 _tls = threading.local()
@@ -353,6 +631,13 @@ class _SanLock:
         got = self._inner.acquire(blocking, timeout)
         if got:
             _held().append(self)
+            # held-NAME counts beside the instance list: fieldsan's
+            # guard check is one dict probe instead of a scan (the
+            # probe runs on every declared-field access)
+            names = getattr(_tls, "held_names", None)
+            if names is None:
+                names = _tls.held_names = {}
+            names[self.name] = names.get(self.name, 0) + 1
         return got
 
     def release(self) -> None:
@@ -360,6 +645,13 @@ class _SanLock:
         for i in range(len(held) - 1, -1, -1):
             if held[i] is self:
                 del held[i]
+                names = getattr(_tls, "held_names", None)
+                if names is not None:
+                    n = names.get(self.name, 1) - 1
+                    if n <= 0:
+                        names.pop(self.name, None)
+                    else:
+                        names[self.name] = n
                 break
         self._inner.release()
 

@@ -12,6 +12,7 @@ socket; object payloads ride shared memory (``object_store.py``).
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import queue
@@ -29,6 +30,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import exceptions
 from . import events
+from . import fieldsan
 from . import history as history_mod
 from . import locksan
 from . import memory_monitor
@@ -155,6 +157,7 @@ def _pdbg(msg):
         print(f"[pipe {os.getpid()} {time.monotonic():.3f}] {msg}",
               file=sys.stderr, flush=True)
 
+@fieldsan.guarded
 class _PendingQueue:
     """Ready-to-dispatch tasks bucketed by scheduling shape
     (pg, resources, env).
@@ -423,6 +426,7 @@ class _RemotePeer:
             pass
 
 
+@fieldsan.guarded
 class NodeService:
     """One per node. ``head=True`` also hosts the control plane."""
 
@@ -460,7 +464,12 @@ class NodeService:
         # per chunk; entries are revalidated by their own closed/dead
         # flags, so a restarted peer re-resolves on first failure
         self._coll_peers: Dict[bytes, Any] = {}
-        self._next_conn_key = 1
+        # conn keys are minted on BOTH accept threads (unix + tcp):
+        # itertools.count.__next__ is GIL-atomic, where the former
+        # `key = n; n += 1` could mint the same key on both threads
+        # and alias two connections in _conns (found by the ISSUE-15
+        # guarded-by audit)
+        self._conn_keys = itertools.count(1)
         self._workers: Dict[WorkerID, _Worker] = {}
         self._idle: deque = deque()
         self._num_starting = 0
@@ -494,10 +503,15 @@ class NodeService:
         # per-spec _dispatch so the burst is one scheduling pass
         self._in_batch = False
         # resources routed to a peer but not yet visible in its gossiped
-        # availability: {node_id: [(monotonic_ts, resources), ...]}.
-        # Subtracted from _candidates so a burst doesn't pile onto one
-        # node through a stale view (RaySyncer-staleness bridge).
-        self._route_debits: Dict[NodeID, List[Tuple[float, Dict[str, float]]]] = {}
+        # availability: {node_id: [(monotonic_ts, resources,
+        # resource_version_at_debit), ...]}. Subtracted from _candidates
+        # so a burst doesn't pile onto one node through a stale view
+        # (RaySyncer-staleness bridge); a debit expires when the peer
+        # gossips a NEWER snapshot (version advance) or at the TTL.
+        self._route_debits: Dict[NodeID, List[tuple]] = {}
+        # last gossiped resource_version per node (stamped by
+        # _candidates, consumed by _debit_route)
+        self._node_versions: Dict[NodeID, int] = {}
         # where each task WE submitted ran, outliving the _owned entry
         # (popped at completion): the read path probes this node's
         # store before asking the head's directory (owner-based
@@ -681,8 +695,16 @@ class NodeService:
                            # leave startup-concurrency headroom so a
                            # runtime-env spawn isn't stuck behind the wave
                            CONFIG.maximum_startup_concurrency - 2))
-        for _ in range(n_pre):
-            self._spawn_worker()
+        if n_pre:
+            # Spawn ON the dispatcher thread: _spawn_worker mutates
+            # dispatcher-owned state (_workers/_idle/_num_starting), and
+            # the dispatcher is already live here — an early worker's
+            # REGISTER (decrementing _num_starting) raced this loop's
+            # `+= 1` on the main thread, and the lost update permanently
+            # skewed the startup-concurrency budget (found by fieldsan,
+            # ISSUE 15).
+            self._events.put(("timer", lambda: [
+                self._spawn_worker() for _ in range(n_pre)]))
         telemetry.attach_node(self)
         self.events.info("NODE_START", "node service started",
                          resources=dict(self.resources_total),
@@ -794,8 +816,7 @@ class NodeService:
             except OSError:
                 return
             conn = P.Connection(sock)
-            key = self._next_conn_key
-            self._next_conn_key += 1
+            key = next(self._conn_keys)
             self._conns[key] = conn
             t = threading.Thread(target=self._reader_loop, args=(key, conn),
                                  daemon=True)
@@ -2163,10 +2184,19 @@ class NodeService:
     def _debit_route(self, target: NodeID, resources: Dict[str, float]) -> None:
         """Remember resources just routed to a peer so the next routing
         decision doesn't see them as still free (gossiped availability
-        lags by up to a heartbeat)."""
+        lags by up to a heartbeat). Each debit records the peer's
+        resource VERSION at routing time: a later snapshot (version
+        advanced) already reflects the routed task — as lowered
+        availability or as a gossiped pending shape — so the debit
+        expires on version advance, not only on the wall-clock TTL. A
+        fixed TTL alone double-counted: a burst arriving ~1-2s after a
+        previous one saw the peers' fresh free view MINUS the previous
+        burst's still-live debits and herded everything onto the local
+        node (ISSUE 15, the burst-balance root cause)."""
         if resources:
             self._route_debits.setdefault(target, []).append(
-                (time.monotonic(), resources))
+                (time.monotonic(), resources,
+                 self._node_versions.get(target)))
 
     def _candidates(self):
         out = []
@@ -2179,20 +2209,50 @@ class NodeService:
             if svc is not None:
                 if svc.dead:
                     continue
-                # same-process node: availability is exact; just TTL-out
-                # any debits so the dict doesn't grow with routed tasks
+                # same-process node: availability is exact up to its
+                # QUEUE — available_snapshot only reflects dispatched
+                # tasks, so during a deferred-dispatch SUBMIT_BATCH the
+                # whole burst read "2 CPUs free" here and herded onto
+                # this node, leaving spillback to clean up a wave later
+                # (the burst-balance flake's root cause, ISSUE 15).
+                # Queued-but-undispatched demand is capacity already
+                # spoken for: subtract it like a debit that self-clears
+                # the instant the task dispatches.
                 avail = svc.available_snapshot()
-                self._prune_debits(info.node_id, now, ttl)
+                for shape in svc.pending_demand():
+                    for k, v in shape.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                # a task routed to an in-process peer is visible in
+                # NEITHER its snapshot NOR its pending queue until its
+                # dispatcher drains the post_remote event — a burst
+                # routed within that window dogpiled the first free
+                # peer (ISSUE 15). Subtract only YOUNG debits: once the
+                # task lands in the peer's pending view (~ms) the
+                # pending subtraction above takes over, and a long TTL
+                # here would double-count it
+                for ts, res, _ver in self._prune_debits(info.node_id,
+                                                        now, ttl):
+                    if now - ts < min(ttl, 0.25):
+                        for k, v in res.items():
+                            avail[k] = avail.get(k, 0.0) - v
             else:
                 # remote process: availability from heartbeat gossip
                 # (RaySyncer-equivalent); subtract what we routed there
                 # within the debit ttl so a burst doesn't herd onto one
-                # node through the stale view
+                # node through the stale view, plus the node's own
+                # gossiped queued demand (capacity spoken for by tasks
+                # other drivers routed there)
                 avail = dict(info.resources_available
                              or info.resources_total)
-                for _, res in self._prune_debits(info.node_id, now, ttl):
+                for shape in info.pending_shapes or ():
+                    for k, v in shape.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                for _ts, res, _ver in self._prune_debits(
+                        info.node_id, now, ttl,
+                        current_version=info.resource_version):
                     for k, v in res.items():
                         avail[k] = avail.get(k, 0.0) - v
+            self._node_versions[info.node_id] = info.resource_version
             out.append((info.node_id, dict(info.resources_total), avail))
         # nodes that left the cluster take their debit history with them
         for nid in list(self._route_debits):
@@ -2200,11 +2260,23 @@ class NodeService:
                 del self._route_debits[nid]
         return out
 
-    def _prune_debits(self, nid: NodeID, now: float, ttl: float) -> list:
+    def _prune_debits(self, nid: NodeID, now: float, ttl: float,
+                      current_version: Optional[int] = None) -> list:
+        """Drop expired debits: past the wall-clock TTL, or (remote
+        gossip) superseded by a snapshot newer than the one the debit
+        was taken against."""
         debits = self._route_debits.get(nid)
         if not debits:
             return []
-        live = [(ts, res) for ts, res in debits if now - ts < ttl]
+        live = [(ts, res, ver) for ts, res, ver in debits
+                if now - ts < ttl
+                and (current_version is None or ver is None
+                     or current_version <= ver
+                     # a version bump within the submit's own flight
+                     # window may predate the task's arrival at the
+                     # peer — only trust version expiry once the debit
+                     # is old enough for the task to have landed
+                     or now - ts < 0.25)]
         if live:
             self._route_debits[nid] = live
         else:
@@ -2277,6 +2349,12 @@ class NodeService:
                 cands = filtered or cands
             target = sched.pick_node(spec.resources, strategy or sched.DEFAULT,
                                      cands, self.node_id, self._rng)
+            if _PIPE_DEBUG:
+                _pdbg(f"route {spec.task_id.hex()[:8]} "
+                      f"{spec.resources} -> "
+                      f"{target.hex()[:6] if target else None} cands="
+                      + " ".join(f"{nid.hex()[:6]}:{av}"
+                                 for nid, _tot, av in cands))
         owned = self._owned.get(spec.task_id)
         if target is None:
             if self._park_infeasible("task", spec):
@@ -2629,6 +2707,10 @@ class NodeService:
         if (depth <= 1 or pg_key is not None
                 or any(r == "TPU" for r, _ in res)):
             return
+        if len(bucket) < 2:
+            # the lease-reuse win only pays on task streams; see the
+            # matching len(bucket) > 1 condition in the drain loop
+            return
         for w in self._workers.values():
             if not bucket:
                 break
@@ -2640,7 +2722,14 @@ class NodeService:
                 # would park until it unblocks (and could BE what it
                 # waits on)
                 continue
-            while bucket and len(w.pipeline) + 1 < depth:
+            # drain down to ONE remaining task, never to zero: a piped
+            # task leaves _pending — invisible to _spill_starved_pending
+            # — so the bucket's last task always stays schedulable/
+            # spillback-rescuable instead of starving head-of-line
+            # behind a long occupant while another node idles (the
+            # ISSUE 15 burst-audit regression, closed for every bucket
+            # size, not just lone tasks)
+            while len(bucket) > 1 and len(w.pipeline) + 1 < depth:
                 rec = bucket[0]
                 if rec.no_pipe or rec.kind != "task":
                     # bounced-once tasks and actor creations (which
@@ -2785,6 +2874,7 @@ class NodeService:
             rec.accel_ids = self._return_tpu_slots(rec.accel_ids)
         rec.charge = None
 
+    # concurrency: requires(node.res)
     def _return_tpu_slots(self, ids) -> None:
         """Return exclusive slot ids to the pool (callers hold
         ``_res_lock``); returns None for assign-back convenience."""
@@ -4314,9 +4404,15 @@ class NodeService:
                     out[k] = out.get(k, 0.0) + v
             return out
         if what == "nodes":
+            # resources_available / pending_shapes expose the gossiped
+            # view the router consumes (RaySyncer-equivalent): tests and
+            # operators can poll the EXACT staleness the scheduler sees
             return [{"node_id": n.node_id, "address": n.address,
                      "resources": n.resources_total, "alive": n.alive,
-                     "labels": n.labels}
+                     "labels": n.labels,
+                     "resources_available": dict(n.resources_available
+                                                 or {}),
+                     "pending_shapes": list(n.pending_shapes or ())}
                     for n in self.gcs.nodes_snapshot()]
         if what == "store_stats":
             return self.store.stats()

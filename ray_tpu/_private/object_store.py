@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from multiprocessing import shared_memory, resource_tracker
 from typing import Dict, List, Optional, Tuple
 
+from . import fieldsan
 from . import locksan
 from .config import CONFIG
 from .ids import ObjectID
@@ -157,6 +158,7 @@ class _Entry:
     writer_tag: Optional[int] = None
 
 
+@fieldsan.guarded
 class ObjectStore:
     """Node-local authority over object values.
 
@@ -268,6 +270,7 @@ class ObjectStore:
             self._used += size
             return (self._arena.path, off)
 
+    # concurrency: requires(store.entries)
     def _release_unsealed_locked(self, object_id: ObjectID,
                                  e: "_Entry") -> None:
         """Pop an unsealed entry and free its allocation (callers hold
@@ -354,6 +357,7 @@ class ObjectStore:
             e = self._entries.get(object_id)
             return e is not None and e.sealed
 
+    # concurrency: requires(store.entries)
     def _touch(self, object_id: ObjectID) -> Optional[_Entry]:
         """Lookup + LRU touch + restore-if-spilled; callers hold _lock.
         Handing out a meta marks the entry read (see _Entry.ever_read)."""
@@ -397,6 +401,7 @@ class ObjectStore:
             if e is not None and e.pinned > 0:
                 e.pinned -= 1
 
+    # concurrency: requires(store.entries)
     def _free_arena_block(self, e: _Entry) -> None:
         """Release an owned arena block; quarantine it if any reader may
         still hold zero-copy views into it (ADVICE r1: unconditional free
@@ -408,6 +413,7 @@ class ObjectStore:
         else:
             self._arena.free(off)
 
+    # concurrency: requires(store.entries)
     def _sweep_quarantine(self) -> None:
         """Callers hold _lock. Deadlines are appended in monotonic order
         (constant delay), so sweeping the prefix is enough."""
@@ -672,6 +678,7 @@ class ObjectStore:
             return out
 
     # ------------------------------------------------------- spill/restore
+    # concurrency: requires(store.entries)
     def _ensure_capacity(self, incoming: int) -> None:
         threshold = CONFIG.object_spilling_threshold * self._capacity
         if self._used + incoming <= threshold:
@@ -691,6 +698,7 @@ class ObjectStore:
                 # (segments are safe — the kernel refcounts attachments)
                 self._spill(oid, e)
 
+    # concurrency: requires(store.entries)
     def _spill(self, object_id: ObjectID, e: _Entry) -> None:
         os.makedirs(self._spill_dir, exist_ok=True)
         path = os.path.join(self._spill_dir, _segment_name(object_id))
@@ -724,6 +732,7 @@ class ObjectStore:
         e.charged = False
         self.num_spilled += 1
 
+    # concurrency: requires(store.entries)
     def _restore(self, object_id: ObjectID, e: _Entry) -> None:
         self._ensure_capacity(e.meta.size)
         off = (self._arena.alloc(e.meta.size)
@@ -811,6 +820,7 @@ def read_wire_bytes(meta: ObjectMeta) -> Optional[bytes]:
     return None
 
 
+@fieldsan.guarded
 class ObjectReader:
     """Per-process cache of attached segments for zero-copy reads."""
 

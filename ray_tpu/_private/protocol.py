@@ -25,6 +25,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import fieldsan
 from . import locksan
 from . import telemetry
 from .config import CONFIG
@@ -443,6 +444,7 @@ def _est_size(payload, depth: int = 3) -> int:
     return 64
 
 
+@fieldsan.guarded
 class Connection:
     """Framed-message socket: batched, vectored, thread-safe sends
     through a per-connection writer thread; burst receives.

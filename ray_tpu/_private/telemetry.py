@@ -37,6 +37,7 @@ import warnings
 from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from . import fieldsan
 from . import locksan
 from .config import CONFIG
 
@@ -245,6 +246,7 @@ class _Hist:
         self.f_count = 0
 
 
+@fieldsan.guarded
 class _Shard:
     def __init__(self):
         self.lock = locksan.lock("telemetry.shard")
@@ -934,3 +936,8 @@ def _install_jax_compile_listener() -> None:
         monitoring.register_event_listener(_on_event)
     except Exception:   # noqa: BLE001 — older/newer jax API drift
         pass
+
+
+# guarded-by plane: wrap the declared module-level registries in
+# checking proxies (no-op when RTPU_FIELDSAN is off)
+fieldsan.instrument_module(globals(), "telemetry")

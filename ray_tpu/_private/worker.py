@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 from .. import exceptions
 from . import context
 from . import failpoints
+from . import fieldsan
 from . import protocol as P
 from . import telemetry
 from .client import CoreClient
@@ -50,6 +51,7 @@ M_ACTOR_RESTORES = telemetry.define(
     "instead of starting empty from __init__")
 
 
+@fieldsan.guarded
 class WorkerRuntime:
     def __init__(self, socket_path: str, node_id: NodeID,
                  worker_id: WorkerID):

@@ -62,6 +62,25 @@ Rules (each has a golden-fixture test in tests/test_concurrency_lint.py):
     have at least one planted call site (a stale row documents chaos
     coverage that doesn't exist).
 
+(h) **Guarded-by field ownership** (``locksan.FIELDS`` — the data-side
+    complement of the lock registry; reference: Clang ``GUARDED_BY``).
+    Sub-checks: every declared guard is a REGISTRY lock (or a
+    non-empty ``thread:``/``atomic:`` declaration); every declared
+    field exists and its class carries ``@fieldsan.guarded`` (modules:
+    a ``fieldsan.instrument_module`` call) so the runtime sanitizer
+    actually sees it; every AST **write** to a lock-guarded field sits
+    lexically under ``with <guard>`` — or inside a function annotated
+    ``# concurrency: requires(<guard>)`` (Clang REQUIRES equivalent;
+    call sites of such functions must themselves hold the guard) — or
+    in ``__init__``, or carries a counted ``# lint: race-ok(<reason>)``
+    waiver; the DESIGN.md "Shared-state ownership map" table mirrors
+    FIELDS both directions; and an **inference pass** flags undeclared
+    candidates — attributes assigned in ``__init__`` and mutated in
+    functions reachable from two different thread entry points
+    (reader roots + ``threading.Thread(target=...)`` functions,
+    reusing rule (d)'s resolution) — so the registry can't rot as the
+    code grows.
+
 Wired into tier-1 (``tests/test_concurrency_lint.py``); standalone:
 ``python -m ray_tpu.scripts.check_concurrency`` (also via ``rtpu lint``).
 """
@@ -83,7 +102,27 @@ _FACTORY_FNS = ("lock", "rlock", "condition")
 _WAIVER_UNDER_LOCK = re.compile(r"#\s*lint:\s*allow-under-lock\(([^)]*)\)")
 _WAIVER_ON_READER = re.compile(r"#\s*lint:\s*allow-on-reader\(([^)]*)\)")
 _WAIVER_OP = re.compile(r"#\s*lint:\s*allow-op\(([^)]*)\)")
+_WAIVER_RACE_OK = re.compile(r"#\s*lint:\s*race-ok\(([^)]*)\)")
 _DISPATCHER_ONLY = re.compile(r"#\s*concurrency:\s*dispatcher-only")
+_REQUIRES = re.compile(r"#\s*concurrency:\s*requires\(([a-z0-9_.]+)\)")
+
+# container methods that mutate their receiver (rule (h): a call
+# ``self.<field>.append(...)`` is a write to <field>)
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "pop", "popleft", "popitem",
+    "remove", "discard", "update", "extend", "extendleft", "clear",
+    "insert", "setdefault", "sort", "reverse", "rotate", "move_to_end",
+    "difference_update", "intersection_update",
+    "symmetric_difference_update",
+})
+
+# the guarded-by plane's target modules (ISSUE 15): FIELDS declarations
+# and the undeclared-candidate inference are scoped to these stems
+_FIELD_MODULES = ("node", "gcs", "client", "worker", "protocol",
+                  "coll_transport", "telemetry", "scheduler",
+                  "object_store", "history")
+
+_OWNERSHIP_HEADING = "## Shared-state ownership map"
 
 # Attribute-call names that block (or can block) the calling thread.
 # ``wait`` is special-cased: allowed on the held lock's own condition.
@@ -183,9 +222,55 @@ def parse_locksan_registry(files) -> Dict[str, tuple]:
     return {}
 
 
+def parse_fields_registry(files) -> Dict[str, str]:
+    """locksan.FIELDS parsed from source (field key -> guard spec) —
+    like the lock registry, never imported."""
+    for rel, tree, _lines in files:
+        if not rel.endswith("locksan.py"):
+            continue
+        for node in ast.walk(tree):
+            tgt = val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                tgt, val = node.target, node.value
+            if (isinstance(tgt, ast.Name) and tgt.id == "FIELDS"
+                    and val is not None):
+                try:
+                    return ast.literal_eval(val)
+                except (ValueError, SyntaxError):
+                    return {}
+    return {}
+
+
 _DESIGN_ROW_RE = re.compile(
     r"^\|\s*`([a-z0-9_.]+)`\s*\|\s*`([^`]+)`\s*\|\s*(\d+)\s*\|"
     r"\s*(\w+)\s*\|", re.MULTILINE)
+
+_OWNERSHIP_ROW_RE = re.compile(
+    r"^\|\s*`([A-Za-z0-9_.]+)`\s*\|\s*`([^`]+)`\s*\|\s*([^|]*)\|",
+    re.MULTILINE)
+
+
+def parse_design_ownership_table(design_path: str) -> List[Tuple[str,
+                                                                 str, str]]:
+    """(field, guard, writers) rows of the DESIGN.md "Shared-state
+    ownership map" table."""
+    try:
+        with open(design_path) as f:
+            text = f.read()
+    except OSError:
+        return []
+    start = text.find(_OWNERSHIP_HEADING)
+    if start < 0:
+        return []
+    body = text[start + len(_OWNERSHIP_HEADING):]
+    end = re.search(r"\n## ", body)
+    if end:
+        body = body[:end.start()]
+    return [(f, g, w.strip()) for f, g, w in
+            _OWNERSHIP_ROW_RE.findall(body)
+            if f != "Field"]
 
 
 def parse_design_lock_table(design_path: str) -> List[Tuple[str, str,
@@ -298,7 +383,19 @@ class CallSite:
     callee: Optional[tuple] = None      # resolved (rel, cls, name)
     waived_under_lock: Optional[str] = None
     waived_on_reader: Optional[str] = None
+    waived_race_ok: Optional[str] = None
     bare: bool = False                  # Name call (not attribute)
+
+
+@dataclass
+class FieldWrite:
+    """One AST write to an attribute/global (rule (h))."""
+
+    name: str                           # attr (self-scope) or global name
+    lineno: int
+    held: Tuple[str, ...]               # lock names held lexically
+    scope: str                          # "self" | "global"
+    waiver: Optional[str] = None        # race-ok reason (None = none)
 
 
 @dataclass
@@ -307,11 +404,15 @@ class FuncInfo:
     lineno: int
     n_params: Tuple[int, int] = (0, 0)  # (required, total) after self
     dispatcher_only: bool = False
+    requires: Optional[str] = None      # declared caller-holds lock
     is_async: bool = False              # coroutine: a call site only
                                         # creates it, never runs it
     with_locks: List[tuple] = field(default_factory=list)
     # [(lockname, lineno, outer_held_names)]
     calls: List[CallSite] = field(default_factory=list)
+    writes: List[FieldWrite] = field(default_factory=list)
+    thread_targets: List[tuple] = field(default_factory=list)
+    # [(recv_chain_or_name, lineno)] of threading.Thread(target=...)
 
 
 def _recv_chain(node) -> Tuple[str, ...]:
@@ -332,8 +433,13 @@ class _Analyzer:
         self.files = _walk_files(self.pkg)
         self.lines = {rel: lines for rel, _t, lines in self.files}
         self.registry = parse_locksan_registry(self.files)
+        self.fields = parse_fields_registry(self.files)
         (self.raw_sites, self.factory_sites,
          self.bindings) = collect_lock_sites(self.files)
+        # rule (h) structural indexes
+        self.guarded_classes: Set[tuple] = set()   # (rel, cls) decorated
+        self.instrumented_mods: Set[str] = set()   # instrument_module args
+        self.class_lines: Dict[tuple, int] = {}    # (rel, cls) -> lineno
         self.funcs: Dict[tuple, FuncInfo] = {}
         self.method_index: Dict[str, List[tuple]] = {}
         self.module_rels = {self._mod_of(rel): rel
@@ -415,9 +521,30 @@ class _Analyzer:
         return ".".join(base[:-level]) if level else ".".join(base[:-1])
 
     def _index_funcs(self, rel, tree, lines):
+        # fieldsan structural evidence: decorated classes and
+        # instrument_module(<globals>, "<mod>") calls in this file
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "instrument_module"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "fieldsan"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)):
+                self.instrumented_mods.add(node.args[1].value)
+
         def visit(node, cls):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, ast.ClassDef):
+                    self.class_lines[(rel, child.name)] = child.lineno
+                    for deco in child.decorator_list:
+                        if ((isinstance(deco, ast.Attribute)
+                             and deco.attr == "guarded"
+                             and isinstance(deco.value, ast.Name)
+                             and deco.value.id == "fieldsan")
+                                or (isinstance(deco, ast.Name)
+                                    and deco.id == "guarded")):
+                            self.guarded_classes.add((rel, child.name))
                     visit(child, child.name)
                 elif isinstance(child, (ast.FunctionDef,
                                         ast.AsyncFunctionDef)):
@@ -440,6 +567,11 @@ class _Analyzer:
                             or _DISPATCHER_ONLY.search(above)
                             or _DISPATCHER_ONLY.search(deco_top)):
                         fi.dispatcher_only = True
+                    for src_line in (head, above, deco_top):
+                        m = _REQUIRES.search(src_line)
+                        if m:
+                            fi.requires = m.group(1)
+                            break
                     self._scan_body(fi, child, rel, cls, lines)
                     self.funcs[key] = fi
                     self.method_index.setdefault(child.name,
@@ -465,11 +597,67 @@ class _Analyzer:
 
     def _scan_body(self, fi: FuncInfo, func_node, rel, cls, lines):
         held: List[str] = []
+        # names this function declares `global`: a whole-name rebind of
+        # one of them is a module-field write (and, at runtime, would
+        # replace a fieldsan proxy — rule (h) must see it)
+        global_names: Set[str] = set()
+        for sub in ast.walk(func_node):
+            if isinstance(sub, ast.Global):
+                global_names.update(sub.names)
+
+        def note_write(target, lineno):
+            """Record a store through ``target`` when it hits a
+            ``self.<attr>`` / module-global field shape (rule (h))."""
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    note_write(elt, lineno)
+                return
+            name = scope = None
+            if isinstance(target, ast.Starred):
+                target = target.value
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id in ("self", "cls")):
+                name, scope = target.attr, "self"
+            elif (isinstance(target, ast.Name)
+                  and target.id in global_names):
+                name, scope = target.id, "global"
+            elif isinstance(target, ast.Subscript):
+                base = target.value
+                if (isinstance(base, ast.Attribute)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id in ("self", "cls")):
+                    name, scope = base.attr, "self"
+                elif isinstance(base, ast.Name):
+                    name, scope = base.id, "global"
+            if name is None:
+                return
+            src = _line(lines, lineno)
+            m = _WAIVER_RACE_OK.search(src)
+            fi.writes.append(FieldWrite(
+                name=name, lineno=lineno, held=tuple(held), scope=scope,
+                waiver=m.group(1).strip() if m else None))
+            if m:
+                self.waivers.append(("race-ok", rel, lineno,
+                                     m.group(1).strip()))
 
         def walk(node):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)):
                 return                      # separate scope/thread
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    note_write(tgt, node.lineno)
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+                return
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    note_write(tgt, node.lineno)
+                return
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 pushed = 0
                 for item in node.items:
@@ -501,13 +689,16 @@ class _Analyzer:
                     src = _line(lines, node.lineno)
                     m_u = _WAIVER_UNDER_LOCK.search(src)
                     m_r = _WAIVER_ON_READER.search(src)
+                    m_k = _WAIVER_RACE_OK.search(src)
                     cs = CallSite(
                         lineno=node.lineno, func_name=name,
                         recv=recv or (), held=tuple(held), bare=bare,
                         waived_under_lock=(m_u.group(1).strip()
                                            if m_u else None),
                         waived_on_reader=(m_r.group(1).strip()
-                                          if m_r else None))
+                                          if m_r else None),
+                        waived_race_ok=(m_k.group(1).strip()
+                                        if m_k else None))
                     fi.calls.append(cs)
                     if m_u:
                         self.waivers.append(("allow-under-lock", rel,
@@ -517,6 +708,32 @@ class _Analyzer:
                         self.waivers.append(("allow-on-reader", rel,
                                              node.lineno,
                                              cs.waived_on_reader))
+                    if m_k:
+                        self.waivers.append(("race-ok", rel,
+                                             node.lineno,
+                                             cs.waived_race_ok))
+                    # container-mutator calls are writes to the field
+                    if name in _MUTATOR_METHODS and recv:
+                        if len(recv) == 2 and recv[0] in ("self", "cls"):
+                            fi.writes.append(FieldWrite(
+                                name=recv[1], lineno=node.lineno,
+                                held=tuple(held), scope="self",
+                                waiver=cs.waived_race_ok))
+                        elif len(recv) == 1:
+                            fi.writes.append(FieldWrite(
+                                name=recv[0], lineno=node.lineno,
+                                held=tuple(held), scope="global",
+                                waiver=cs.waived_race_ok))
+                    # thread entry points (rule (h) inference roots)
+                    if name == "Thread":
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                fi.thread_targets.append(
+                                    (_recv_chain(kw.value)
+                                     if isinstance(kw.value,
+                                                   (ast.Attribute,
+                                                    ast.Name))
+                                     else (), node.lineno))
             for child in ast.iter_child_nodes(node):
                 walk(child)
 
@@ -1263,6 +1480,346 @@ def check_failpoint_registry(files) -> List[str]:
     return problems
 
 
+# ================================================= rule (h): guarded fields
+
+def _stem_rels(an: _Analyzer) -> Dict[str, str]:
+    """module short name -> rel path, preferring _private/<stem>.py."""
+    out: Dict[str, str] = {}
+    for rel, _t, _l in an.files:
+        stem = os.path.basename(rel)[:-3]
+        posix = rel.replace(os.sep, "/")
+        if posix == f"_private/{stem}.py" or stem not in out:
+            if posix == f"_private/{stem}.py" or f"/{stem}.py" not in \
+                    out.get(stem, "").replace(os.sep, "/"):
+                out.setdefault(stem, rel)
+        if posix == f"_private/{stem}.py":
+            out[stem] = rel
+    return out
+
+
+def _parse_guard(spec: str) -> Tuple[str, str]:
+    """(kind, payload): ("thread", pat) | ("atomic", reason) |
+    ("lock", name) | ("static-lock", name). static-lock fields carry
+    full rule-(h) write verification but are exempt from runtime
+    instrumentation (the documented hot-path form, ``"<lock>|static"``)."""
+    if spec.startswith("thread:"):
+        return "thread", spec[len("thread:"):].strip()
+    if spec.startswith("atomic:"):
+        return "atomic", spec[len("atomic:"):].strip()
+    if spec.endswith("|static"):
+        return "static-lock", spec[:-len("|static")]
+    return "lock", spec
+
+
+def _module_level_names(tree) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        tgts = []
+        if isinstance(node, ast.Assign):
+            tgts = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            tgts = [node.target]
+        for t in tgts:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _check_fields(an: _Analyzer, design_path: str) -> List[str]:
+    problems: List[str] = []
+    fields = an.fields
+    if not fields:
+        return ["locksan.FIELDS not found/parseable — the guarded-by "
+                "field scanner is broken"]
+    reg = an.registry
+    stem_rel = _stem_rels(an)
+    trees = {rel: tree for rel, tree, _l in an.files}
+
+    declared_self: Dict[tuple, Dict[str, tuple]] = {}
+    declared_glob: Dict[str, Dict[str, tuple]] = {}
+    for key, spec in sorted(fields.items()):
+        parts = key.split(".")
+        kind, payload = _parse_guard(spec)
+        if kind in ("thread", "atomic") and not payload:
+            problems.append(
+                f"field {key}: {kind}: declaration with an empty "
+                f"{'pattern' if kind == 'thread' else 'reason'}")
+        if kind in ("lock", "static-lock") and payload not in reg:
+            problems.append(
+                f"field {key}: guard {payload!r} is not a declared "
+                "lock in locksan.REGISTRY")
+        if len(parts) == 3:
+            rel = stem_rel.get(parts[0])
+            if rel is None:
+                problems.append(
+                    f"field {key}: module {parts[0]!r} not found under "
+                    "ray_tpu/ — stale registry row")
+                continue
+            declared_self.setdefault((rel, parts[1]), {})[parts[2]] = \
+                (key, spec, kind)
+        elif len(parts) == 2:
+            rel = stem_rel.get(parts[0])
+            if rel is None:
+                problems.append(
+                    f"field {key}: module {parts[0]!r} not found under "
+                    "ray_tpu/ — stale registry row")
+                continue
+            declared_glob.setdefault(rel, {})[parts[1]] = \
+                (key, spec, kind)
+        else:
+            problems.append(
+                f"field {key}: key must be <module>.<Class>.<attr> or "
+                "<module>.<name>")
+
+    # existence + instrumentation evidence
+    written_attrs: Dict[tuple, Set[str]] = {}
+    for (rel, cls, _name), fi in an.funcs.items():
+        if cls is None:
+            continue
+        s = written_attrs.setdefault((rel, cls), set())
+        for w in fi.writes:
+            if w.scope == "self":
+                s.add(w.name)
+    for (rel, cls), attrs in sorted(declared_self.items()):
+        if (rel, cls) not in an.class_lines:
+            for attr, (key, _spec, _kind) in sorted(attrs.items()):
+                problems.append(
+                    f"field {key}: class {cls} not found in {rel} — "
+                    "stale registry row")
+            continue
+        have = written_attrs.get((rel, cls), set())
+        for attr, (key, _spec, _kind) in sorted(attrs.items()):
+            if attr not in have:
+                problems.append(
+                    f"field {key}: attribute never assigned in {cls} "
+                    "— stale registry row")
+        if (any(k not in ("atomic", "static-lock")
+                for _key, _s, k in attrs.values())
+                and (rel, cls) not in an.guarded_classes):
+            problems.append(
+                f"{rel}:{an.class_lines[(rel, cls)]}: class {cls} "
+                "declares guarded fields but lacks @fieldsan.guarded — "
+                "the runtime sanitizer cannot instrument them")
+    for rel, names in sorted(declared_glob.items()):
+        stem = os.path.basename(rel)[:-3]
+        mod_names = _module_level_names(trees[rel])
+        for name, (key, _spec, kind) in sorted(names.items()):
+            if name not in mod_names:
+                problems.append(
+                    f"field {key}: module-level name never assigned in "
+                    f"{rel} — stale registry row")
+        if (any(k not in ("atomic", "static-lock")
+                for _key, _s, k in names.values())
+                and stem not in an.instrumented_mods):
+            problems.append(
+                f"{rel}: declares module-level guarded fields but "
+                f"never calls fieldsan.instrument_module(globals(), "
+                f"{stem!r}) — the runtime sanitizer cannot see them")
+
+    # every write to a lock-guarded field sits under its guard
+    for (rel, cls, fname), fi in sorted(
+            an.funcs.items(), key=lambda kv: (kv[0][0], kv[0][1] or "",
+                                              kv[0][2])):
+        if fi.requires and fi.requires not in reg:
+            problems.append(
+                f"{rel}:{fi.lineno}: {fname} requires({fi.requires}) "
+                "names an undeclared lock")
+        self_decl = declared_self.get((rel, cls), {}) if cls else {}
+        glob_decl = declared_glob.get(rel, {})
+        for w in fi.writes:
+            decl = (self_decl.get(w.name) if w.scope == "self"
+                    else glob_decl.get(w.name))
+            if decl is None:
+                continue
+            key, spec, kind = decl
+            if kind not in ("lock", "static-lock"):
+                continue
+            gname = (spec[:-len("|static")] if kind == "static-lock"
+                     else spec)
+            if fname == "__init__" and w.scope == "self":
+                continue        # single-threaded construction window
+            if w.waiver is not None:
+                if not w.waiver:
+                    problems.append(
+                        f"{rel}:{w.lineno}: race-ok waiver with an "
+                        "empty reason")
+                continue
+            held = set(w.held)
+            if fi.requires:
+                held.add(fi.requires)
+            if gname not in held:
+                where = ("under " + "/".join(sorted(set(w.held)))
+                         if w.held else "with no lock held")
+                problems.append(
+                    f"{rel}:{w.lineno}: write to {key} (guarded by "
+                    f"{gname!r}) {where} — wrap it in `with` of its "
+                    f"guard, annotate the function `# concurrency: "
+                    f"requires({gname})`, or waive with "
+                    "# lint: race-ok(reason)")
+
+    # requires() call-site discipline (Clang REQUIRES at the caller)
+    for (rel, cls, fname), fi in sorted(
+            an.funcs.items(), key=lambda kv: (kv[0][0], kv[0][1] or "",
+                                              kv[0][2])):
+        for cs in fi.calls:
+            if cs.callee is None or cs.callee not in an.funcs:
+                continue
+            req = an.funcs[cs.callee].requires
+            if not req:
+                continue
+            if cs.waived_race_ok is not None:
+                if not cs.waived_race_ok:
+                    problems.append(
+                        f"{rel}:{cs.lineno}: race-ok waiver with an "
+                        "empty reason")
+                continue
+            if req in cs.held or fi.requires == req \
+                    or fname == "__init__":
+                continue
+            problems.append(
+                f"{rel}:{cs.lineno}: calls {cs.callee[2]!r} (declared "
+                f"`requires({req})`) without holding {req!r}")
+
+    # DESIGN.md ownership map mirrors FIELDS, both directions
+    rows = parse_design_ownership_table(design_path)
+    if not rows:
+        problems.append(
+            "DESIGN.md has no 'Shared-state ownership map' table — the "
+            "declared field ownership must be documented")
+        return problems
+    doc: Dict[str, tuple] = {}
+    for f, g, wtext in rows:
+        if f in doc:
+            problems.append(
+                f"field {f!r}: duplicate DESIGN.md ownership row")
+        doc[f] = (g, wtext)
+    for key, spec in sorted(fields.items()):
+        want = "atomic" if spec.startswith("atomic:") else spec
+        d = doc.get(key)
+        if d is None:
+            problems.append(
+                f"field {key}: in locksan.FIELDS but missing from the "
+                "DESIGN.md ownership map")
+        elif d[0] != want:
+            problems.append(
+                f"field {key}: DESIGN.md guard column {d[0]!r} "
+                f"disagrees with locksan.FIELDS ({want!r})")
+        elif not d[1]:
+            problems.append(
+                f"field {key}: DESIGN.md ownership row has an empty "
+                "writer-threads column")
+    for f in sorted(set(doc) - set(fields)):
+        problems.append(
+            f"field {f!r}: documented in DESIGN.md but absent from "
+            "locksan.FIELDS — stale doc row")
+    return problems
+
+
+def _thread_roots(an: _Analyzer) -> Dict[tuple, str]:
+    """Thread entry points: rule (d)'s reader roots + every function
+    handed to ``threading.Thread(target=...)``."""
+    roots: Dict[tuple, str] = {}
+    for rel, cls, name in _READER_ROOTS:
+        key = (rel.replace("/", os.sep), cls, name)
+        if key in an.funcs:
+            roots[key] = f"reader:{cls}.{name}"
+    for (rel, cls, _fname), fi in an.funcs.items():
+        for recv, _lineno in fi.thread_targets:
+            tkey = None
+            if len(recv) == 2 and recv[0] in ("self", "cls"):
+                tkey = (rel, cls, recv[1])
+            elif len(recv) == 1:
+                tkey = (rel, None, recv[0])
+                if tkey not in an.funcs:
+                    tkey = (rel, cls, recv[0])
+            if tkey is not None and tkey in an.funcs:
+                roots.setdefault(
+                    tkey, f"thread:{(tkey[1] + '.') if tkey[1] else ''}"
+                          f"{tkey[2]}")
+    return roots
+
+
+def _reachability(an: _Analyzer,
+                  roots: Dict[tuple, str]) -> Dict[tuple, Set[str]]:
+    reach: Dict[tuple, Set[str]] = {}
+    for rkey, label in roots.items():
+        seen = {rkey}
+        frontier = [rkey]
+        while frontier:
+            k = frontier.pop()
+            reach.setdefault(k, set()).add(label)
+            fi = an.funcs.get(k)
+            if fi is None:
+                continue
+            for cs in fi.calls:
+                callee = cs.callee
+                if callee is None or callee in seen:
+                    continue
+                cfi = an.funcs.get(callee)
+                if cfi is None or cfi.is_async:
+                    continue
+                seen.add(callee)
+                frontier.append(callee)
+    return reach
+
+
+def _infer_undeclared(an: _Analyzer) -> List[str]:
+    """Inference pass: attributes assigned in ``__init__`` and written
+    outside it from functions that two different thread entry points
+    can reach must be DECLARED (guard / thread-confined / atomic) —
+    the registry can't silently rot as code grows."""
+    problems: List[str] = []
+    fields = an.fields
+    stem_rel = _stem_rels(an)
+    target_rels = {stem_rel[s]: s for s in _FIELD_MODULES
+                   if s in stem_rel}
+    reach = _reachability(an, _thread_roots(an))
+
+    init_attrs: Dict[tuple, Set[str]] = {}
+    for (rel, cls, fname), fi in an.funcs.items():
+        if cls is None or fname != "__init__" or rel not in target_rels:
+            continue
+        s = init_attrs.setdefault((rel, cls), set())
+        for w in fi.writes:
+            if w.scope == "self":
+                s.add(w.name)
+
+    # attr -> {labels of thread roots reaching a writer}
+    writer_labels: Dict[tuple, Set[str]] = {}
+    writer_sites: Dict[tuple, List[tuple]] = {}
+    for (rel, cls, fname), fi in an.funcs.items():
+        if cls is None or fname == "__init__" or rel not in target_rels:
+            continue
+        for w in fi.writes:
+            if w.scope != "self":
+                continue
+            if (rel, cls) not in init_attrs \
+                    or w.name not in init_attrs[(rel, cls)]:
+                continue
+            labels = reach.get((rel, cls, fname)) or {"driver"}
+            k = (rel, cls, w.name)
+            writer_labels.setdefault(k, set()).update(labels)
+            writer_sites.setdefault(k, []).append((fname, w.lineno))
+
+    for (rel, cls, attr), labels in sorted(writer_labels.items()):
+        if len(labels) < 2:
+            continue
+        stem = target_rels[rel]
+        key = f"{stem}.{cls}.{attr}"
+        if key in fields:
+            continue
+        sites = ", ".join(f"{fn}:{ln}"
+                          for fn, ln in sorted(writer_sites[
+                              (rel, cls, attr)])[:4])
+        problems.append(
+            f"undeclared shared-field candidate {key}: mutated at "
+            f"{sites} in functions reachable from "
+            f"{'/'.join(sorted(labels))} — declare its guard in "
+            "locksan.FIELDS (lock, thread:<owner>, or "
+            "atomic:<reason>)")
+    return problems
+
+
 # ================================================================== driver
 
 def analyze(repo_root: Optional[str] = None) -> _Analyzer:
@@ -1286,6 +1843,8 @@ def check(repo_root: Optional[str] = None,
     problems += check_config_registry(an.files,
                                       os.path.join(root, "README.md"))
     problems += check_failpoint_registry(an.files)
+    problems += _check_fields(an, os.path.join(root, "DESIGN.md"))
+    problems += _infer_undeclared(an)
     return problems
 
 

@@ -19,6 +19,14 @@ import os
 # which inherits the env) imports locksan.
 os.environ.setdefault("RTPU_LOCKSAN", "1")
 
+# Guarded-by field sanitizer (ISSUE 15): beside the lock-order checks,
+# every tier-1 test also verifies that threads touching declared shared
+# fields (locksan.FIELDS) hold the declared guard — cross-thread
+# read-write/write-write pairs with an unguarded write side are
+# reported with both stacks. setdefault so perf runs can opt out with
+# RTPU_FIELDSAN=0; must be set BEFORE ray_tpu imports fieldsan.
+os.environ.setdefault("RTPU_FIELDSAN", "1")
+
 # The axon sitecustomize pins JAX_PLATFORMS=axon (real chip); tests run on
 # a virtual 8-device CPU mesh, which needs both the env override and the
 # config update (the sitecustomize's register() call re-adds axon).
@@ -40,13 +48,21 @@ import ray_tpu  # noqa: E402
 def pytest_sessionfinish(session, exitstatus):
     # surface driver-process sanitizer reports in the summary (worker
     # processes print theirs to worker logs, forwarded to stdout live)
-    from ray_tpu._private import locksan
+    from ray_tpu._private import fieldsan, locksan
 
     v = locksan.violations()
     if v:
         print(f"\n[locksan] {len(v)} lock-order violation(s) observed "
               "in the driver process — see [locksan] stderr reports "
               "above")
+    fv = fieldsan.violations()
+    if fv:
+        fields = sorted({r["field"] for r in fv})
+        print(f"\n[fieldsan] {len(fv)} guarded-by violation(s) observed "
+              f"in the driver process across {len(fields)} field(s) "
+              f"({', '.join(fields[:8])}"
+              f"{', ...' if len(fields) > 8 else ''}) — see [fieldsan] "
+              "stderr reports above")
 
 
 @pytest.fixture

@@ -15,6 +15,19 @@ def _tokens(cfg, b=4, s=64):
                               cfg.vocab_size)
 
 
+
+# Feature probes for this box's jax (0.4.x): the sharded model paths
+# use the jax>=0.5 top-level APIs (jax.shard_map / jax.set_mesh).
+# skipif on the PROBE, not a version string, so the gate lifts itself
+# the moment the runtime jax grows the API (ISSUE 15: tier-1 reads
+# honestly green instead of carrying a known-red set).
+_HAS_SHARD_MAP = hasattr(jax, "shard_map")
+_needs_shard_map = pytest.mark.skipif(
+    not _HAS_SHARD_MAP,
+    reason=f"jax {jax.__version__} lacks top-level jax.shard_map "
+           "(the sharded attention path requires it)")
+
+
 def test_moe_forward_and_training():
     cfg = llama_tiny(n_experts=4, moe_top_k=2)
     model = GPT(cfg)
@@ -36,6 +49,7 @@ def test_moe_forward_and_training():
     assert losses[-1] < losses[0]
 
 
+@_needs_shard_map
 def test_moe_ep_sharded():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
@@ -97,6 +111,7 @@ def test_pipeline_rejects_bad_config():
         GPT(llama_tiny(n_experts=2), mesh=mesh_like)  # EP+PP
 
 
+@_needs_shard_map
 def test_sharded_compile_no_involuntary_remat(capfd):
     """Regression pin for the r03/r04 remat fix (gpt.py embedding gather):
     compiling the sp/tp/fsdp train step must emit zero spmd_partitioner

@@ -53,6 +53,12 @@ def test_with_logical_constraint_noop_outside_mesh():
     assert (np.asarray(y) == 1).all()
 
 
+# feature probe, not a version pin: jax.set_mesh is the jax>=0.5
+# spelling this test exercises; the skip lifts itself when the
+# runtime jax grows it (ISSUE 15 — tier-1 reads honestly green)
+@pytest.mark.skipif(
+    not hasattr(jax, "set_mesh"),
+    reason=f"jax {jax.__version__} lacks jax.set_mesh")
 def test_with_logical_constraint_under_mesh():
     mesh = build_mesh(mesh_shape_for(8, tp=2))
     with jax.set_mesh(mesh):
