@@ -43,11 +43,18 @@ inline uint64_t align_up(uint64_t v, uint64_t a) {
 // Block layout: [BlockHeader | payload ...]; blocks are physically
 // contiguous, walked by size for coalescing. size field includes the
 // header. prev_size lets us find the previous block for merging.
+// refcnt counts mapper references: any attached process increments it
+// while it hands out zero-copy views into the payload, so the owner
+// can tell "no live reader" apart from "freed but maybe still aliased"
+// (plasma analogue: the per-object client refcount in the store's
+// object table). Updated with atomic builtins — the field lives in
+// shared memory and is touched from multiple processes.
 struct BlockHeader {
   uint64_t size_flags;   // size | kUsedBit
   uint64_t prev_size;    // size of physically-previous block (0 = first)
   uint64_t payload;      // requested payload size
-  uint64_t pad[5];
+  uint64_t refcnt;       // live mapper references (cross-process atomic)
+  uint64_t pad[4];
   uint64_t size() const { return size_flags & ~kUsedBit; }
   bool used() const { return size_flags & kUsedBit; }
 };
@@ -177,10 +184,23 @@ int64_t arena_alloc(void* handle, uint64_t payload) {
     b->size_flags = need | kUsedBit;
   }
   b->payload = payload;
+  __atomic_store_n(&b->refcnt, 0, __ATOMIC_RELAXED);
   a->super->used += need;
   a->super->num_blocks += 1;
   return (int64_t)(best_off + kHeaderSize);
 }
+
+namespace {
+// Shared validation for the refcount entry points: any attached process
+// (owner or reader) may call them, so only offset sanity is checked.
+BlockHeader* ref_block(void* handle, int64_t payload_off) {
+  Arena* a = static_cast<Arena*>(handle);
+  if (!a || payload_off < (int64_t)kHeaderSize) return nullptr;
+  uint64_t off = (uint64_t)payload_off - kHeaderSize;
+  if (off >= a->capacity) return nullptr;
+  return block_at(a, off);
+}
+}  // namespace
 
 int arena_free(void* handle, int64_t payload_off) {
   Arena* a = static_cast<Arena*>(handle);
@@ -221,6 +241,32 @@ int arena_free(void* handle, int64_t payload_off) {
   if (after_off < a->capacity)
     block_at(a, after_off)->prev_size = size;
   return 0;
+}
+
+int64_t arena_incref(void* handle, int64_t payload_off) {
+  BlockHeader* b = ref_block(handle, payload_off);
+  if (!b || !b->used()) return -1;
+  return (int64_t)(__atomic_add_fetch(&b->refcnt, 1, __ATOMIC_ACQ_REL));
+}
+
+int64_t arena_decref(void* handle, int64_t payload_off) {
+  BlockHeader* b = ref_block(handle, payload_off);
+  if (!b) return -1;
+  // decref may land after the owner already freed the block (reader
+  // dropped its last view late); the count still balances because free
+  // doesn't recycle header bytes until realloc, and alloc re-zeroes it.
+  uint64_t prev = __atomic_fetch_sub(&b->refcnt, 1, __ATOMIC_ACQ_REL);
+  if (prev == 0) {  // underflow guard: restore and report
+    __atomic_store_n(&b->refcnt, 0, __ATOMIC_RELAXED);
+    return -1;
+  }
+  return (int64_t)(prev - 1);
+}
+
+int64_t arena_refcount(void* handle, int64_t payload_off) {
+  BlockHeader* b = ref_block(handle, payload_off);
+  if (!b) return -1;
+  return (int64_t)__atomic_load_n(&b->refcnt, __ATOMIC_ACQUIRE);
 }
 
 uint8_t* arena_base(void* handle) {

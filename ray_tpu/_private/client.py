@@ -571,7 +571,7 @@ class CoreClient:
         adopted + published it and no PUT rpc is needed."""
         smeta, views = ser.serialize(value)
         total = ser.serialized_size(smeta, views)
-        if total <= CONFIG.max_inline_object_bytes:
+        if total <= CONFIG.object_store_shm_threshold_bytes:
             return ObjectMeta(object_id=oid, size=total,
                               inline=_flat_bytes(smeta, views, total)), False
         meta = self._local_store_large(oid, smeta, views, total)
@@ -588,6 +588,18 @@ class CoreClient:
         node = self.local_node
         if node is None or getattr(node, "dead", False):
             return None
+        if CONFIG.object_store_lazy_put:
+            try:
+                meta = node.store.put_lazy(oid, smeta, views, total)
+            except Exception:   # store unhealthy: RPC path decides
+                return None
+            if meta is not None:
+                # zero bytes copied: the serialized views stay in this
+                # process's heap until first cross-process demand (or
+                # spill pressure) promotes them to the arena
+                node._seal_object(meta)
+                return meta
+            return None         # duplicate put: RPC path decides
         try:
             buf, meta = node.store.create_local(oid, total)
         except Exception:       # store full / duplicate: RPC path decides
@@ -614,7 +626,7 @@ class CoreClient:
         """Cross-host put: the payload rides the socket (out-of-band as
         a zero-copy iovec when large) and the NODE materializes it as
         the primary copy (we have no shared shm)."""
-        if total <= CONFIG.max_inline_object_bytes:
+        if total <= CONFIG.object_store_shm_threshold_bytes:
             self._send(P.PUT_OBJECT,
                        ObjectMeta(object_id=oid, size=total, inline=data))
         else:
@@ -807,7 +819,7 @@ class CoreClient:
         finally:
             contained = end_ref_capture()
         total = ser.serialized_size(smeta, views)
-        if total <= CONFIG.max_inline_object_bytes:
+        if total <= CONFIG.object_store_shm_threshold_bytes:
             out = bytearray(total)
             ser.write_to(memoryview(out), smeta, views)
             return ("v", bytes(out))

@@ -19,13 +19,26 @@ _ENV_PREFIX = "RTPU_"
 _CONFIG_DEFS: Dict[str, tuple] = {
     # --- object store ---
     "object_store_memory_mb": (int, 2048, "shm budget for the local object store"),
-    "max_inline_object_bytes": (int, 100 * 1024,
-                                "results <= this are carried inline in RPC replies "
-                                "(reference: task_rpc_inlined_bytes_limit)"),
-    "object_spilling_threshold": (float, 0.8,
-                                  "fraction of store memory above which primary "
-                                  "copies are spilled to disk"),
-    "spill_directory": (str, "", "directory for spilled objects (default: session dir)"),
+    "object_store_shm_max_bytes": (int, 0,
+                                   "byte-denominated override of the store/arena "
+                                   "budget; 0 = object_store_memory_mb << 20"),
+    "object_store_shm_threshold_bytes": (int, 100 * 1024,
+                                         "values <= this stay on the in-heap inline "
+                                         "path (carried in RPC frames); larger values "
+                                         "land in the shm arena / a segment "
+                                         "(reference: task_rpc_inlined_bytes_limit)"),
+    "object_store_spill_threshold": (float, 0.8,
+                                     "fraction of store memory above which coldest "
+                                     "unpinned primary copies are spilled to disk"),
+    "object_store_spill_dir": (str, "",
+                               "directory for spilled objects (default: session dir)"),
+    "object_store_lazy_put": (bool, True,
+                              "head-driver puts of large values defer the shm copy "
+                              "until first cross-process demand or spill pressure "
+                              "(zero-copy put; the serialized views alias the "
+                              "caller's buffers until promotion, so a put value "
+                              "must not be mutated afterwards — same immutability "
+                              "contract the reference's plasma copies enforce)"),
     "use_native_arena": (bool, True,
                          "allocate store objects from the C++ shm arena "
                          "(native/object_arena.cpp) when the library builds; "
@@ -413,6 +426,16 @@ _CONFIG_DEFS: Dict[str, tuple] = {
 }
 
 
+# Renamed knobs: old name -> canonical name. Old env vars
+# (RTPU_<OLD_NAME>) and _system_config keys keep working; attribute
+# reads of the old name resolve to the canonical value.
+_ALIASES: Dict[str, str] = {
+    "max_inline_object_bytes": "object_store_shm_threshold_bytes",
+    "object_spilling_threshold": "object_store_spill_threshold",
+    "spill_directory": "object_store_spill_dir",
+}
+
+
 class _Config:
     """Process-wide config singleton. Read via attribute access."""
 
@@ -428,8 +451,14 @@ class _Config:
                 values[name] = self._parse(typ, raw)
             else:
                 values[name] = default
+        for old, new in _ALIASES.items():
+            raw = os.environ.get(_ENV_PREFIX + old.upper())
+            if (raw is not None
+                    and os.environ.get(_ENV_PREFIX + new.upper()) is None):
+                values[new] = self._parse(_CONFIG_DEFS[new][0], raw)
         if system_config:
             for key, val in system_config.items():
+                key = _ALIASES.get(key, key)
                 if key not in _CONFIG_DEFS:
                     raise ValueError(f"unknown config key: {key}")
                 values[key] = val
@@ -445,7 +474,7 @@ class _Config:
 
     def __getattr__(self, name: str):
         try:
-            return self._values[name]
+            return self._values[_ALIASES.get(name, name)]
         except KeyError:
             raise AttributeError(name) from None
 

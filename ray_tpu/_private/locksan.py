@@ -407,6 +407,12 @@ FIELDS: Dict[str, str] = {
     "object_store.ObjectStore._quarantine": "store.entries",
     "object_store.ObjectStore.num_spilled": "store.entries",
     "object_store.ObjectStore.num_restored": "store.entries",
+    "object_store.ObjectStore.num_lazy_puts": "store.entries",
+    "object_store.ObjectStore.num_materialized": "store.entries",
+    "object_store.ObjectStore.spilled_bytes_total": "store.entries",
+    "object_store.ObjectStore.restored_bytes_total": "store.entries",
+    "object_store.ObjectStore._spill_events": "store.entries",
+    "object_store.ObjectStore._manifest_f": "store.entries|static",
     "object_store.ObjectReader._segments": "store.reader_segments",
     # --- transport (protocol.Connection)
     "protocol.Connection._outq": "conn.queue|static",

@@ -33,24 +33,27 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_LIB_PATH):
-            if not os.path.isdir(_NATIVE_DIR):
-                _build_failed = True
-                return None
+        if os.path.isdir(_NATIVE_DIR):
             try:
                 # inter-process flock: many workers may race the first
-                # build; exactly one runs make, the rest wait on the lock
+                # build; exactly one runs make, the rest wait on the lock.
+                # make runs even when the .so exists — a stale build from
+                # an older source (missing newer symbols) must be rebuilt,
+                # and an up-to-date one is a no-op stat check.
                 import fcntl
                 lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
                 with open(lock_path, "w") as lock_f:
                     fcntl.flock(lock_f, fcntl.LOCK_EX)
-                    if not os.path.exists(_LIB_PATH):
-                        subprocess.run(["make", "-s"], cwd=_NATIVE_DIR,  # lint: allow-under-lock(one-time build; the lock is what makes exactly one thread run make)
-                                       check=True, capture_output=True,
-                                       timeout=120)
+                    subprocess.run(["make", "-s"], cwd=_NATIVE_DIR,  # lint: allow-under-lock(one-time build; the lock is what makes exactly one thread run make)
+                                   check=True, capture_output=True,
+                                   timeout=120)
             except Exception:
-                _build_failed = True
-                return None
+                if not os.path.exists(_LIB_PATH):
+                    _build_failed = True
+                    return None
+        elif not os.path.exists(_LIB_PATH):
+            _build_failed = True
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -74,6 +77,15 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.arena_num_blocks.argtypes = [ctypes.c_void_p]
         lib.arena_close.restype = None
         lib.arena_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        try:
+            # mapper refcounts; absent in .so builds from older sources
+            # (refcount callers degrade to the time quarantine)
+            for sym in ("arena_incref", "arena_decref", "arena_refcount"):
+                fn = getattr(lib, sym)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -82,7 +94,34 @@ def available() -> bool:
     return _load() is not None
 
 
-class Arena:
+class _RefcountMixin:
+    """Per-block mapper refcounts, shared by owner and reader handles.
+    All three degrade to None/no-op on a library built from an older
+    source (no arena_incref symbol)."""
+
+    def incref(self, offset: int) -> Optional[int]:
+        fn = getattr(self._lib, "arena_incref", None)
+        if fn is None or not self._handle:
+            return None
+        n = fn(self._handle, offset)
+        return None if n < 0 else n
+
+    def decref(self, offset: int) -> Optional[int]:
+        fn = getattr(self._lib, "arena_decref", None)
+        if fn is None or not self._handle:
+            return None
+        n = fn(self._handle, offset)
+        return None if n < 0 else n
+
+    def refcount(self, offset: int) -> Optional[int]:
+        fn = getattr(self._lib, "arena_refcount", None)
+        if fn is None or not self._handle:
+            return None
+        n = fn(self._handle, offset)
+        return None if n < 0 else n
+
+
+class Arena(_RefcountMixin):
     """Owner-side arena (the node store process allocates; readers use
     ``ArenaReader``)."""
 
@@ -126,7 +165,7 @@ class Arena:
             self._handle = None
 
 
-class ArenaReader:
+class ArenaReader(_RefcountMixin):
     """Reader-side attachment (one mmap per process per arena)."""
 
     _cache: dict = {}
@@ -155,6 +194,27 @@ class ArenaReader:
         addr = ctypes.addressof(base.contents) + offset
         return memoryview((ctypes.c_ubyte * size).from_address(addr)) \
             .cast("B")
+
+    def tracked_buffer(self, offset: int, size: int) -> memoryview:
+        """Zero-copy view that holds a mapper reference on the block:
+        increfs now, decrefs when the last derived view is collected
+        (weakref.finalize on the backing ctypes array — every numpy
+        view/memoryview slice keeps that array alive). The owner defers
+        free/spill of the block while the count is nonzero, so user code
+        can hold views indefinitely without a reuse-corruption window.
+        Raises FileNotFoundError when the block was already freed (the
+        meta was stale) — callers retry through a fresh GET exactly like
+        a spilled-and-unlinked segment."""
+        base = self._lib.arena_base(self._handle)
+        addr = ctypes.addressof(base.contents) + offset
+        arr = (ctypes.c_ubyte * size).from_address(addr)
+        if getattr(self._lib, "arena_incref", None) is not None:
+            if self.incref(offset) is None:
+                raise FileNotFoundError(
+                    f"arena block @{offset} already freed")
+            import weakref
+            weakref.finalize(arr, self.decref, offset)
+        return memoryview(arr).cast("B")
 
     def close(self) -> None:
         if self._handle:

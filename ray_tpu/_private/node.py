@@ -951,6 +951,7 @@ class NodeService:
         self._rescue_stalled_waiters()
         self._sweep_stalls()
         self._sweep_object_leaks()
+        self._drain_spill_events()
         self._record_metrics_history()
         # _dispatch fails pending tasks whose env exceeded the startup
         # failure budget (see the wid-None path)
@@ -1066,6 +1067,54 @@ class NodeService:
                 object_id=oid.hex(),
                 object_node_id=(loc.hex() if loc is not None else None),
                 **rec)
+
+    def _drain_spill_events(self) -> None:
+        """Publish the store's spill/restore activity recorded since the
+        last tick: byte counters for the doctor/bench planes plus
+        attributed OBJECT_SPILLED / OBJECT_RESTORED cluster events — the
+        spill carries the object's creation callsite from the PR-11
+        provenance table when the plane is in-process. Runs outside the
+        store lock by design (the store only queues; emitting under its
+        lock would nest store.entries → gcs/telemetry locks)."""
+        try:
+            evts = self.store.drain_spill_events()
+        except Exception:   # noqa: BLE001 — ticks must survive the store
+            return
+        if not evts:
+            return
+        spilled_bytes = sum(sz for kind, _, sz in evts if kind == "spill")
+        restored = sum(1 for kind, _, _ in evts if kind == "restore")
+        if spilled_bytes:
+            telemetry.counter_inc(telemetry.M_OBJ_SPILLED_BYTES,
+                                  float(spilled_bytes), self._mtags)
+        if restored:
+            telemetry.counter_inc(telemetry.M_OBJ_RESTORED,
+                                  float(restored), self._mtags)
+        prov: dict = {}
+        if isinstance(self.gcs, GlobalControlPlane):
+            try:
+                prov = self.gcs.objects_info(
+                    [oid for kind, oid, _ in evts if kind == "spill"])
+            except Exception:   # noqa: BLE001 — events still emit bare
+                prov = {}
+        for kind, oid, size in evts:
+            if kind == "spill":
+                rec = prov.get(oid) or {}
+                callsite = rec.get("callsite")
+                where = f" created at {callsite}" if callsite else ""
+                self.events.info(
+                    "OBJECT_SPILLED",
+                    f"object {oid.hex()[:12]} ({size} B){where} spilled "
+                    f"to disk under memory pressure",
+                    object_id=oid.hex(), size=size, callsite=callsite,
+                    creator=(str(rec["creator"])
+                             if rec.get("creator") else None))
+            else:
+                self.events.info(
+                    "OBJECT_RESTORED",
+                    f"object {oid.hex()[:12]} ({size} B) restored from "
+                    f"its spill file on demand",
+                    object_id=oid.hex(), size=size)
 
     def _record_metrics_history(self) -> None:
         """Tick-driven history snapshot: the plane-hosting node (same

@@ -18,7 +18,9 @@ LRU eviction + spilling). Design differences, on purpose:
 
 from __future__ import annotations
 
+import glob
 import itertools
+import json
 import os
 import pickle
 import time
@@ -29,10 +31,12 @@ from typing import Dict, List, Optional, Tuple
 
 from . import fieldsan
 from . import locksan
+from . import serialization as ser
 from .config import CONFIG
 from .ids import ObjectID
 
 _SHM_PREFIX = "rtpu"
+_SHM_DIR = "/dev/shm"
 
 # Secondary-copy (adopted) segments get a per-call unique suffix: two
 # concurrent pulls of the same object in one process must not collide on
@@ -51,9 +55,61 @@ def _mk_meta(t: tuple) -> "ObjectMeta":
     ``ObjectMeta.__reduce__``)."""
     m = ObjectMeta.__new__(ObjectMeta)
     (oid, m.size, m.inline, m.shm_name, m.error, m.node_hint,
-     m.arena_ref) = t
+     m.arena_ref, m.flags) = t
     m.object_id = ObjectID(oid)
     return m
+
+
+def _proc_start_token(pid: int) -> Optional[str]:
+    """Process identity token: the kernel start time (field 22 of
+    ``/proc/<pid>/stat``, in jiffies). A (pid, starttime) pair uniquely
+    names one process incarnation, so a recycled pid can't masquerade as
+    a live manifest owner. None off-Linux (reaping degrades to never)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # comm (field 2) may contain spaces/parens; fields resume after
+        # the LAST ')' — starttime is the 20th field from there
+        return stat[stat.rindex(b")") + 2:].split()[19].decode()
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def reap_orphan_shm(root: str = _SHM_DIR) -> int:
+    """Unlink shm artifacts (arena file + segments) left by stores whose
+    owner process died without ``shutdown()`` (SIGKILL, OOM-kill). Every
+    store appends what it creates to a small manifest file in /dev/shm
+    keyed by (pid, starttime); this scans all manifests, skips live
+    owners, and removes everything a dead owner left behind — reference
+    analogue: the raylet's plasma directory cleanup on restart. Called
+    from every store __init__ (so the next node to start on the host
+    collects the garbage) and from ``rtpu`` CLI paths. Returns the
+    number of artifacts removed."""
+    reaped = 0
+    for mf in glob.glob(os.path.join(root, "rtpu_manifest_*")):
+        try:
+            with open(mf, "r") as f:
+                lines = f.read().splitlines()
+            hdr = json.loads(lines[0])
+        except (OSError, ValueError, IndexError):
+            continue
+        pid = hdr.get("pid")
+        if pid and _proc_start_token(pid) == hdr.get("start"):
+            continue                      # owner incarnation still alive
+        for name in [hdr.get("arena")] + lines[1:]:
+            if not name:
+                continue
+            path = name if os.path.isabs(name) else os.path.join(root, name)
+            try:
+                os.unlink(path)
+                reaped += 1
+            except OSError:
+                pass
+        try:
+            os.unlink(mf)
+        except OSError:
+            pass
+    return reaped
 
 
 def _segment_name(object_id: ObjectID) -> str:
@@ -99,6 +155,16 @@ def attach_segment(name: str) -> shared_memory.SharedMemory:
 class ObjectMeta:
     """Where an object's value lives; travels in RPC messages."""
 
+    # flag bits (``flags``):
+    # LAZY — the primary's bytes still live in the owner process's heap,
+    # promoted to shm on first cross-process demand (reference analogue:
+    # CoreWorker in-memory store → plasma promotion). SPILLED — the
+    # primary lives in a spill file on the owner's disk; directory rows
+    # sharing this meta thereby advertise the spilled location
+    # (restore-on-get clears it).
+    LAZY = 1
+    SPILLED = 2
+
     object_id: ObjectID
     size: int
     inline: Optional[bytes] = None  # wire-format bytes, for small objects
@@ -108,6 +174,7 @@ class ObjectMeta:
     # (arena_path, payload_offset): object lives in the node's C++ shm
     # arena (plasma-style Create/Seal; ``native/object_arena.cpp``)
     arena_ref: Optional[tuple] = None
+    flags: int = 0
 
     def __reduce_ex__(self, protocol):
         # hot-path pickle: metas ride every TASK_DONE / GET_REPLY /
@@ -130,14 +197,19 @@ class ObjectMeta:
                 inline = bytes(inline)
         return (_mk_meta, ((self.object_id.binary(), self.size,
                             inline, self.shm_name, self.error,
-                            self.node_hint, self.arena_ref),))
+                            self.node_hint, self.arena_ref, self.flags),))
 
     def is_error(self) -> bool:
         return self.error is not None
 
     def has_value(self) -> bool:
+        # a LAZY or SPILLED meta has a value — it just isn't mappable
+        # right now (resolvable through the owner, like any remote
+        # location)
         return (self.inline is not None or self.shm_name is not None
-                or self.arena_ref is not None or self.error is not None)
+                or self.arena_ref is not None or self.error is not None
+                or bool(self.flags & (ObjectMeta.LAZY
+                                      | ObjectMeta.SPILLED)))
 
 
 @dataclass
@@ -156,6 +228,9 @@ class _Entry:
     ever_read: bool = False
     # connection that holds an unsealed Create; its death reclaims it
     writer_tag: Optional[int] = None
+    # lazy primary: (serialized_meta_bytes, out-of-band views) still in
+    # this process's heap; promoted by _materialize_locked on demand
+    lazy: Optional[tuple] = None
 
 
 @fieldsan.guarded
@@ -176,12 +251,27 @@ class ObjectStore:
                  spill_dir: Optional[str] = None):
         self._lock = locksan.rlock("store.entries")
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
-        self._capacity = capacity_bytes or CONFIG.object_store_memory_mb * (1 << 20)
+        self._capacity = (capacity_bytes
+                          or CONFIG.object_store_shm_max_bytes
+                          or CONFIG.object_store_memory_mb * (1 << 20))
         self.ARENA_MAX_OBJECT = max(64 << 20, self._capacity // 4)
         self._used = 0
-        self._spill_dir = spill_dir or CONFIG.spill_directory or "/tmp/rtpu_spill"
+        self._spill_dir = spill_dir or CONFIG.object_store_spill_dir or "/tmp/rtpu_spill"
         self.num_spilled = 0
         self.num_restored = 0
+        self.num_lazy_puts = 0
+        self.num_materialized = 0
+        self.spilled_bytes_total = 0
+        self.restored_bytes_total = 0
+        # ("spill"|"restore", ObjectID, size) tuples appended under _lock
+        # and drained by the node service, which emits the attributed
+        # OBJECT_SPILLED/OBJECT_RESTORED events + byte counters OUTSIDE
+        # the store lock (the store must not call into gcs/telemetry with
+        # its lock held — lock-order hygiene)
+        self._spill_events: List[tuple] = []
+        # collect what crashed predecessors left in /dev/shm before we
+        # add our own arena/segments to it
+        reap_orphan_shm()
         # C++ shm arena (plasma-equivalent allocator). One mapping per
         # node; all readers attach once. Optional: pure-python segments
         # remain the fallback and the path for huge objects.
@@ -203,6 +293,40 @@ class ObjectStore:
                     self._arena = native.Arena(path, self._capacity)
             except Exception:
                 self._arena = None
+        # crash manifest: everything this store parks in /dev/shm is
+        # recorded here (header: owner identity + arena path; one line
+        # per segment), so reap_orphan_shm() can clean up after a
+        # SIGKILL'd node. Flushed per append — durability against
+        # SIGKILL is the whole point.
+        self._manifest_f = None
+        self._manifest_path = None
+        try:
+            self._manifest_path = os.path.join(
+                _SHM_DIR,
+                f"rtpu_manifest_{os.getpid()}_{os.urandom(4).hex()}")
+            self._manifest_f = open(self._manifest_path, "w")
+            self._manifest_f.write(json.dumps({
+                "pid": os.getpid(),
+                "start": _proc_start_token(os.getpid()),
+                "arena": self._arena.path if self._arena else None,
+            }) + "\n")
+            self._manifest_f.flush()
+        except OSError:
+            self._manifest_f = None
+            self._manifest_path = None
+
+    def _manifest_add(self, name: Optional[str]) -> None:
+        """Record a segment this store owns in the crash manifest (the
+        file object serializes concurrent appends; each append is one
+        short write + flush)."""
+        f = self._manifest_f
+        if f is None or not name:
+            return
+        try:
+            f.write(name + "\n")
+            f.flush()
+        except (OSError, ValueError):
+            pass
 
     # ------------------------------------------------------------------ put
     def put_inline(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
@@ -219,12 +343,67 @@ class ObjectStore:
             self._used += len(data)
         return meta
 
+    def put_lazy(self, object_id: ObjectID, smeta: bytes,
+                 views: List[memoryview], total: int) -> Optional[ObjectMeta]:
+        """Zero-copy put for a SAME-PROCESS writer (the head driver): the
+        serialized form — meta pickle + out-of-band views straight into
+        the caller's buffers — is parked in-heap and the entry is sealed
+        immediately; **no bytes are copied at put time**. Promotion to
+        the arena/a segment (the one unavoidable copy) happens on first
+        cross-process demand, restore-blocking spill pressure, or pull —
+        and never happens for objects freed unread. Reference analogue:
+        the CoreWorker's in-memory store, from which objects are promoted
+        to plasma only when another process needs them.
+
+        The views alias the caller's object storage, so a caller that
+        mutates the source object before the first get can observe its
+        own mutation (documented at ``object_store_lazy_put``).
+        Returns None when a sealed copy already exists (duplicate put)."""
+        meta = ObjectMeta(object_id=object_id, size=total,
+                          flags=ObjectMeta.LAZY)
+        with self._lock:
+            if object_id in self._entries:
+                return None
+            self._ensure_capacity(total)
+            self._entries[object_id] = _Entry(
+                meta=meta, sealed=True, charged=True,
+                lazy=(smeta, list(views)))
+            self._used += total
+            self.num_lazy_puts += 1
+        return meta
+
+    # concurrency: requires(store.entries)
+    def _materialize_locked(self, e: _Entry) -> None:
+        """Promote a lazy primary into shared memory (arena block when it
+        fits, else an owned segment). Budget was charged at put_lazy time
+        so only the physical home changes here."""
+        smeta, views = e.lazy
+        size = e.meta.size
+        off = (self._arena.alloc(size)
+               if (self._arena is not None
+                   and size <= self.ARENA_MAX_OBJECT) else None)
+        if off is not None:
+            ser.write_to(self._arena.buffer(off, size), smeta, views)
+            e.meta.arena_ref = (self._arena.path, off)
+        else:
+            seg = shared_memory.SharedMemory(
+                create=True, size=max(size, 1),
+                name=_segment_name(e.meta.object_id))
+            self._manifest_add(seg.name)
+            ser.write_to(seg.buf, smeta, views)
+            e.segment = seg
+            e.meta.shm_name = seg.name
+        e.meta.flags &= ~ObjectMeta.LAZY
+        e.lazy = None
+        self.num_materialized += 1
+
     def create(self, object_id: ObjectID, size: int) -> memoryview:
         """Allocate a shm segment; caller fills it then calls seal()."""
         with self._lock:
             self._ensure_capacity(size)
             seg = shared_memory.SharedMemory(
                 create=True, size=max(size, 1), name=_segment_name(object_id))
+            self._manifest_add(seg.name)
             meta = ObjectMeta(object_id=object_id, size=size,
                               shm_name=seg.name)
             self._entries[object_id] = _Entry(meta=meta, segment=seg,
@@ -323,7 +502,7 @@ class ObjectStore:
         segment and must clean it up)."""
         if meta.inline is not None and not isinstance(meta.inline, bytes):
             # inline metas in the oob band (>= transport_oob_threshold,
-            # <= max_inline_object_bytes) decode as memoryviews into the
+            # <= object_store_shm_threshold_bytes) decode as memoryviews into the
             # recv frame buffer; a store-resident copy must not pin that
             # whole frame (up to transport_max_batch_bytes) per object
             meta.inline = bytes(meta.inline)
@@ -343,9 +522,17 @@ class ObjectStore:
                     self._release_unsealed_locked(meta.object_id, existing)
                 else:
                     return False
-            charged = bool(meta.shm_name or meta.inline)
+            # charge: segments/inline always; arena refs only when the
+            # block lives in OUR arena (the ingest path of adopt_begin —
+            # a foreign arena_ref is metadata about a remote node's copy)
+            arena_owned = (meta.arena_ref is not None
+                           and self._arena is not None
+                           and meta.arena_ref[0] == self._arena.path)
+            charged = bool(meta.shm_name or meta.inline) or arena_owned
             if charged:
                 self._ensure_capacity(meta.size)
+            if meta.shm_name:
+                self._manifest_add(meta.shm_name)
             self._entries[meta.object_id] = _Entry(meta=meta, sealed=True,
                                                    charged=charged)
             self._used += meta.size if charged else 0
@@ -369,6 +556,10 @@ class ObjectStore:
         self._entries.move_to_end(object_id)
         if e.spilled_path is not None:
             self._restore(object_id, e)
+        if e.lazy is not None:
+            # the meta is about to leave this process: promote so it
+            # names a mappable location
+            self._materialize_locked(e)
         return e
 
     def get_meta(self, object_id: ObjectID) -> Optional[ObjectMeta]:
@@ -416,11 +607,23 @@ class ObjectStore:
     # concurrency: requires(store.entries)
     def _sweep_quarantine(self) -> None:
         """Callers hold _lock. Deadlines are appended in monotonic order
-        (constant delay), so sweeping the prefix is enough."""
+        (constant delay), so sweeping the prefix is enough. A block whose
+        mapper refcount is still nonzero when its window expires (a
+        reader process legitimately holding a long-lived zero-copy view,
+        tracked by ``ArenaReader.tracked_buffer``) is requeued for
+        another window instead of freed under the reader — the fixed
+        window alone only covers readers that map *promptly*."""
         now = time.monotonic()
+        requeue = []
         while self._quarantine and self._quarantine[0][0] <= now:
             _, off = self._quarantine.pop(0)
+            rc = self._arena.refcount(off)
+            if rc is not None and rc > 0:
+                requeue.append(
+                    (now + max(CONFIG.arena_free_quarantine_s, 1.0), off))
+                continue
             self._arena.free(off)
+        self._quarantine.extend(requeue)
 
     def free(self, object_ids: List[ObjectID]) -> None:
         with self._lock:
@@ -484,6 +687,8 @@ class ObjectStore:
             e.last_used = time.monotonic()
             e.ever_read = True
             self._entries.move_to_end(object_id)
+            if e.lazy is not None:
+                self._materialize_locked(e)
             meta = e.meta
             if meta.inline is not None or meta.error is not None:
                 return (meta, None)
@@ -539,17 +744,31 @@ class ObjectStore:
 
     def adopt_begin(self, object_id: ObjectID, size: int) -> "_AdoptWriter":
         """Incremental adoption of a pulled copy: allocate the backing
-        segment up front, stream chunks in, then finish() seals it as a
+        store up front, stream chunks in, then finish() seals it as a
         local secondary copy.
 
-        Deliberately a PRIVATE segment, never an arena Create: an arena
-        Create registers an unsealed entry, and a concurrent adopt() of
-        the same id (e.g. a local reconstruction finishing mid-pull)
-        treats unsealed entries as abandoned writers and frees the
-        block the streaming writer is still copying into."""
+        Prefers a RAW arena block so the PR-4 OOB frames land with one
+        mmap write (recv buffer → arena; no private-segment intermediate
+        and no extra first-touch faulting). The block is deliberately NOT
+        registered as an entry until finish(): an unsealed entry would
+        let a concurrent adopt() of the same id (e.g. a local
+        reconstruction finishing mid-pull) treat it as an abandoned
+        writer and free the block the streaming writer is still copying
+        into — finish() adopts (charging the budget then) or frees the
+        block on a lost race. Falls back to a private segment when the
+        arena is absent/full/out of size class."""
+        off = None
+        if self._arena is not None and size <= self.ARENA_MAX_OBJECT:
+            with self._lock:
+                self._sweep_quarantine()
+                self._ensure_capacity(size)
+                off = self._arena.alloc(size)
+        if off is not None:
+            return _AdoptWriter(self, object_id, size, arena_off=off)
         seg = shared_memory.SharedMemory(
             create=True, size=max(size, 1),
             name=_adopt_segment_name(object_id))
+        self._manifest_add(seg.name)
         return _AdoptWriter(self, object_id, size, segment=seg)
 
     def adopt_payload(self, object_id: ObjectID, data: bytes) -> ObjectMeta:
@@ -669,18 +888,41 @@ class ObjectStore:
                 "capacity_bytes": self._capacity,
                 "num_spilled": self.num_spilled,
                 "num_restored": self.num_restored,
+                "spilled_bytes_total": self.spilled_bytes_total,
+                "restored_bytes_total": self.restored_bytes_total,
+                "num_lazy_puts": self.num_lazy_puts,
+                "num_materialized": self.num_materialized,
                 "arena_enabled": int(self._arena is not None),
             }
+            shm_bytes = 0
+            for e in self._entries.values():
+                m = e.meta
+                if m.shm_name is not None or (
+                        m.arena_ref is not None and self._arena is not None
+                        and m.arena_ref[0] == self._arena.path):
+                    shm_bytes += m.size
+            out["shm_bytes"] = shm_bytes
             if self._arena is not None:
                 out["arena_used_bytes"] = self._arena.used
+                out["arena_capacity_bytes"] = self._arena.capacity
                 out["arena_num_blocks"] = self._arena.num_blocks
                 out["arena_quarantined_blocks"] = len(self._quarantine)
+            return out
+
+    def drain_spill_events(self) -> List[tuple]:
+        """Hand the accumulated ("spill"|"restore", oid, size) records to
+        the node service, which emits the attributed cluster events and
+        byte counters outside the store lock."""
+        with self._lock:
+            if not self._spill_events:
+                return []
+            out, self._spill_events = self._spill_events, []
             return out
 
     # ------------------------------------------------------- spill/restore
     # concurrency: requires(store.entries)
     def _ensure_capacity(self, incoming: int) -> None:
-        threshold = CONFIG.object_spilling_threshold * self._capacity
+        threshold = CONFIG.object_store_spill_threshold * self._capacity
         if self._used + incoming <= threshold:
             return
         for oid in list(self._entries):
@@ -690,25 +932,46 @@ class ObjectStore:
             if not (e.sealed and e.pinned == 0 and e.spilled_path is None
                     and e.charged):
                 continue
-            if e.meta.shm_name is not None:
+            if e.lazy is not None or e.meta.shm_name is not None:
                 self._spill(oid, e)
-            elif e.meta.arena_ref is not None and not e.ever_read:
-                # read arena entries never spill: readers may hold
-                # zero-copy views and arena blocks are reused after free
-                # (segments are safe — the kernel refcounts attachments)
-                self._spill(oid, e)
+            elif e.meta.arena_ref is not None:
+                # a READ arena entry may have live zero-copy views into
+                # its block; spill it only when the cross-process mapper
+                # refcount proves it idle (the block still rides the free
+                # quarantine so a reader holding just the meta reads the
+                # intact bytes until the window drains). No refcount API
+                # (older .so) → stay conservative: unread entries only.
+                rc = (self._arena.refcount(e.meta.arena_ref[1])
+                      if (self._arena is not None
+                          and e.meta.arena_ref[0] == self._arena.path)
+                      else None)
+                if not e.ever_read or rc == 0:
+                    self._spill(oid, e)
 
     # concurrency: requires(store.entries)
     def _spill(self, object_id: ObjectID, e: _Entry) -> None:
         os.makedirs(self._spill_dir, exist_ok=True)
         path = os.path.join(self._spill_dir, _segment_name(object_id))
-        if e.meta.arena_ref is not None:
-            if self._arena is None:
+        if e.lazy is not None:
+            # lazy primary under pressure: serialize straight to disk —
+            # the value never transits shm at all (put → disk, one copy)
+            smeta, views = e.lazy
+            with open(path, "wb") as f:
+                ser.write_file(f, smeta, views)
+            e.lazy = None
+            e.meta.flags &= ~ObjectMeta.LAZY
+        elif e.meta.arena_ref is not None:
+            if (self._arena is None
+                    or e.meta.arena_ref[0] != self._arena.path):
                 return
             off = e.meta.arena_ref[1]
             with open(path, "wb") as f:
                 f.write(self._arena.buffer(off, e.meta.size))
-            self._arena.free(off)
+            # quarantined, not freed, when the entry was ever read: a
+            # reader still holding the meta keeps reading the intact old
+            # bytes until the window (and its mapper refcount) drains,
+            # after which its incref fails cleanly and it re-GETs
+            self._free_arena_block(e)
             e.meta.arena_ref = None
         else:
             seg = e.segment
@@ -728,9 +991,12 @@ class ObjectStore:
             e.segment = None
             e.meta.shm_name = None
         e.spilled_path = path
+        e.meta.flags |= ObjectMeta.SPILLED
         self._used -= e.meta.size
         e.charged = False
         self.num_spilled += 1
+        self.spilled_bytes_total += e.meta.size
+        self._spill_events.append(("spill", e.meta.object_id, e.meta.size))
 
     # concurrency: requires(store.entries)
     def _restore(self, object_id: ObjectID, e: _Entry) -> None:
@@ -746,15 +1012,19 @@ class ObjectStore:
             seg = shared_memory.SharedMemory(
                 create=True, size=max(e.meta.size, 1),
                 name=_segment_name(object_id))
+            self._manifest_add(seg.name)
             with open(e.spilled_path, "rb") as f:
                 f.readinto(seg.buf[:e.meta.size])
             e.segment = seg
             e.meta.shm_name = seg.name
         os.unlink(e.spilled_path)
         e.spilled_path = None
+        e.meta.flags &= ~ObjectMeta.SPILLED
         self._used += e.meta.size
         e.charged = True
         self.num_restored += 1
+        self.restored_bytes_total += e.meta.size
+        self._spill_events.append(("restore", e.meta.object_id, e.meta.size))
 
     def shutdown(self) -> None:
         with self._lock:
@@ -762,36 +1032,64 @@ class ObjectStore:
             if self._arena is not None:
                 self._arena.close(unlink=True)
                 self._arena = None
+            if self._manifest_f is not None:
+                try:
+                    self._manifest_f.close()
+                    os.unlink(self._manifest_path)
+                except OSError:
+                    pass
+                self._manifest_f = None
 
 
 class _AdoptWriter:
-    """Streaming target for a chunked cross-host pull. Not registered
-    in the store until finish() — a half-written copy must never be
-    readable (or freeable) under its object id."""
+    """Streaming target for a chunked cross-host pull — an unregistered
+    arena block (preferred; OOB frames land with one mmap write) or a
+    private segment. Not registered in the store until finish() — a
+    half-written copy must never be readable (or freeable) under its
+    object id."""
 
     def __init__(self, store: "ObjectStore", object_id: ObjectID, size: int,
-                 segment: shared_memory.SharedMemory):
+                 segment: Optional[shared_memory.SharedMemory] = None,
+                 arena_off: Optional[int] = None):
         self._store = store
         self._oid = object_id
         self._size = size
         self._segment = segment
+        self._arena_off = arena_off
+        self._buf = (store._arena.buffer(arena_off, size)
+                     if arena_off is not None else None)
 
-    def write(self, offset: int, data: bytes) -> None:
-        self._segment.buf[offset:offset + len(data)] = data
+    def write(self, offset: int, data) -> None:
+        if self._buf is not None:
+            self._buf[offset:offset + len(data)] = data
+        else:
+            self._segment.buf[offset:offset + len(data)] = data
 
     def finish(self) -> ObjectMeta:
-        meta = ObjectMeta(object_id=self._oid, size=self._size,
-                          shm_name=self._segment.name)
+        if self._arena_off is not None:
+            meta = ObjectMeta(object_id=self._oid, size=self._size,
+                              arena_ref=(self._store._arena.path,
+                                         self._arena_off))
+        else:
+            meta = ObjectMeta(object_id=self._oid, size=self._size,
+                              shm_name=self._segment.name)
         if not self._store.adopt(meta):
             # a sealed copy landed mid-stream (e.g. local reconstruction
-            # finished first): ours is redundant — unlink it or it leaks
+            # finished first): ours is redundant — free it or it leaks
             existing = self._store.get_meta(self._oid)
             self.abort()
             return existing if existing is not None else meta
-        self._segment.close()
+        if self._segment is not None:
+            self._segment.close()
         return meta
 
     def abort(self) -> None:
+        if self._arena_off is not None:
+            # never registered, never read: immediate free is safe
+            self._buf = None
+            self._store._arena.free(self._arena_off)
+            self._arena_off = None
+            return
         try:
             self._segment.close()
             self._segment.unlink()
@@ -810,7 +1108,11 @@ def read_wire_bytes(meta: ObjectMeta) -> Optional[bytes]:
     if meta.arena_ref is not None:
         from . import native
         path, off = meta.arena_ref
-        return bytes(native.ArenaReader.get(path).buffer(off, meta.size))
+        # tracked: the incref pins the block against spill/reuse for the
+        # duration of the copy; raises FileNotFoundError on a stale meta
+        # (block already freed) exactly like a vanished segment would
+        return bytes(native.ArenaReader.get(path).tracked_buffer(
+            off, meta.size))
     if meta.shm_name is not None:
         seg = attach_segment(meta.shm_name)
         try:
@@ -839,7 +1141,13 @@ class ObjectReader:
             from . import native
             path, off = meta.arena_ref
             reader = native.ArenaReader.get(path)
-            return serialization.read_from(reader.buffer(off, meta.size))
+            # tracked_buffer increfs the block's cross-process mapper
+            # refcount and decrefs when the last zero-copy view dies, so
+            # the owner defers free/spill while this process reads.
+            # FileNotFoundError (stale meta, block freed) propagates to
+            # the client's bounded re-GET, same as a vanished segment.
+            return serialization.read_from(
+                reader.tracked_buffer(off, meta.size))
         with self._lock:
             seg = self._segments.get(meta.shm_name)
             if seg is None:

@@ -100,6 +100,18 @@ def write_to(buf: memoryview, meta: bytes, views: List[memoryview]) -> int:
     return total
 
 
+def write_file(f, meta: bytes, views: List[memoryview]) -> int:
+    """Stream the wire format to a file object without assembling a
+    contiguous buffer first (used to spill a lazy object straight from
+    the owner's heap to disk); returns bytes written."""
+    total = serialized_size(meta, views)
+    f.write(_HEADER.pack(total, len(meta)))
+    f.write(meta)
+    for v in views:
+        f.write(v.cast("B") if v.format != "B" or v.ndim != 1 else v)
+    return total
+
+
 def to_bytes(obj: Any) -> bytes:
     """One-shot serialize into a contiguous bytes object."""
     meta, views = serialize(obj)

@@ -745,6 +745,20 @@ M_STORE_OBJECTS = define(
 M_STORE_SPILLED = define(
     "gauge", "rtpu_object_store_spilled_objects",
     "Objects spilled to disk since node start (sampled)")
+M_STORE_SHM_BYTES = define(
+    "gauge", "rtpu_object_store_shm_bytes",
+    "Bytes resident in shared memory (arena blocks + POSIX segments) "
+    "for this node's store (sampled)")
+M_STORE_ARENA_FILL = define(
+    "gauge", "rtpu_object_store_arena_fill_ratio",
+    "arena_used_bytes / arena_capacity_bytes of the node's shm arena "
+    "(sampled; 0 when the native arena is unavailable)")
+M_OBJ_SPILLED_BYTES = define(
+    "counter", "rtpu_object_spilled_bytes_total",
+    "Bytes written to spill files under memory pressure")
+M_OBJ_RESTORED = define(
+    "counter", "rtpu_object_restored_total",
+    "Spilled objects restored on demand (get/task-arg/pull)")
 M_OBJ_CALLSITES = define(
     "counter", "rtpu_object_callsites_recorded_total",
     "Creation callsites captured for puts / task returns / actor "
@@ -857,6 +871,12 @@ def sample_once() -> None:
             gauge_set(M_STORE_OBJECTS, float(stats.get("num_objects", 0)),
                       tags)
             gauge_set(M_STORE_SPILLED, float(stats.get("num_spilled", 0)),
+                      tags)
+            gauge_set(M_STORE_SHM_BYTES, float(stats.get("shm_bytes", 0)),
+                      tags)
+            gauge_set(M_STORE_ARENA_FILL,
+                      (stats.get("arena_used_bytes", 0)
+                       / (stats.get("arena_capacity_bytes", 0) or 1)),
                       tags)
         except Exception:   # noqa: BLE001
             pass

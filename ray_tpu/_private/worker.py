@@ -639,7 +639,7 @@ class WorkerRuntime:
             # TASK_DONE/GEN_ITEM (same conn => ordered).
             self.conn.send((P.RETURN_REFS, (oid, contained)))
         total = ser.serialized_size(smeta, views)
-        if total <= CONFIG.max_inline_object_bytes:
+        if total <= CONFIG.object_store_shm_threshold_bytes:
             out = bytearray(total)
             ser.write_to(memoryview(out), smeta, views)
             return ObjectMeta(object_id=oid, size=total, inline=bytes(out))

@@ -195,3 +195,136 @@ def test_cross_node_get_marks_owner_read(rtpu_cluster):
         pytest.skip("object not arena-backed on the worker node")
     assert entry.ever_read, (
         "cross-node get() bypassed the owner's read tracking")
+
+
+# ------------------------------------------------ mapper refcounts (ISSUE 20)
+
+def _has_refcounts(arena):
+    return arena.refcount(0) is not None or \
+        getattr(arena._lib, "arena_incref", None) is not None
+
+
+def test_refcount_incref_decref(arena):
+    if not _has_refcounts(arena):
+        pytest.skip("library built without refcount symbols")
+    off = arena.alloc(4096)
+    assert arena.refcount(off) == 0
+    assert arena.incref(off) == 1
+    assert arena.incref(off) == 2
+    assert arena.decref(off) == 1
+    assert arena.decref(off) == 0
+    # underflow is refused and the count stays clamped at zero
+    assert arena.decref(off) is None
+    assert arena.refcount(off) == 0
+    arena.free(off)
+    # freed block: incref must refuse (stale-meta safety)
+    assert arena.incref(off) is None
+
+
+def test_tracked_buffer_holds_and_releases_ref(arena):
+    if not _has_refcounts(arena):
+        pytest.skip("library built without refcount symbols")
+    off = arena.alloc(4096)
+    arena.buffer(off, 4096)[:] = b"z" * 4096
+    reader = native.ArenaReader(arena.path)
+    mv = reader.tracked_buffer(off, 4096)
+    assert bytes(mv[:4]) == b"zzzz"
+    assert arena.refcount(off) == 1          # owner sees the reader's ref
+    view = np.frombuffer(mv, dtype=np.uint8)[100:200]
+    del mv
+    import gc
+    gc.collect()
+    assert arena.refcount(off) == 1, (
+        "derived view alive but the mapper ref was dropped")
+    del view
+    gc.collect()
+    assert arena.refcount(off) == 0
+    arena.free(off)
+    with pytest.raises(FileNotFoundError):
+        reader.tracked_buffer(off, 4096)     # stale meta → clean refusal
+    reader.close()
+
+
+def test_spill_defers_to_live_mapper_refcount(tmp_path):
+    """An ever-read arena entry with a live zero-copy reader (mapper
+    refcount > 0) must survive the spill scan; once the ref drops it is
+    spillable again."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectMeta, ObjectStore
+
+    store = ObjectStore(capacity_bytes=4 << 20, spill_dir=str(tmp_path))
+    if store._arena is None:
+        pytest.skip("arena unavailable")
+    if getattr(store._arena._lib, "arena_incref", None) is None:
+        store.shutdown()
+        pytest.skip("library built without refcount symbols")
+    try:
+        oid = ObjectID.from_random()
+        ref = store.alloc_in_arena(oid, 1 << 20)
+        assert ref is not None
+        store.adopt(ObjectMeta(object_id=oid, size=1 << 20,
+                               arena_ref=ref))
+        meta = store.get_meta(oid)           # marks ever_read
+        reader = native.ArenaReader(store._arena.path)
+        mv = reader.tracked_buffer(meta.arena_ref[1], meta.size)
+        with store._lock:
+            store._capacity = 1 << 16
+            store._ensure_capacity(0)
+        e = store._entries[oid]
+        assert e.spilled_path is None, (
+            "spilled an arena block out from under a live reader")
+        del mv
+        import gc
+        gc.collect()
+        with store._lock:
+            store._ensure_capacity(0)
+        assert e.spilled_path is not None
+        reader.close()
+    finally:
+        store.shutdown()
+
+
+def test_quarantine_requeues_while_refcount_held(tmp_path):
+    """The free quarantine must not release a block whose mapper
+    refcount is still nonzero at window expiry — it re-queues for
+    another window instead."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectMeta, ObjectStore
+
+    store = ObjectStore(capacity_bytes=4 << 20, spill_dir=str(tmp_path))
+    if store._arena is None:
+        pytest.skip("arena unavailable")
+    if getattr(store._arena._lib, "arena_incref", None) is None:
+        store.shutdown()
+        pytest.skip("library built without refcount symbols")
+    old = CONFIG._values["arena_free_quarantine_s"]
+    CONFIG._values["arena_free_quarantine_s"] = 0.2
+    try:
+        oid = ObjectID.from_random()
+        ref = store.alloc_in_arena(oid, 4096)
+        store._arena.buffer(ref[1], 4096)[:] = b"q" * 4096
+        store.adopt(ObjectMeta(object_id=oid, size=4096, arena_ref=ref))
+        meta = store.get_meta(oid)           # ever_read → quarantined free
+        reader = native.ArenaReader(store._arena.path)
+        mv = reader.tracked_buffer(meta.arena_ref[1], 4096)
+        store.free([oid])
+        assert store.stats()["arena_quarantined_blocks"] == 1
+        import gc
+        import time
+        time.sleep(0.3)                      # past the window, ref held
+        with store._lock:
+            store._sweep_quarantine()
+        assert store.stats()["arena_quarantined_blocks"] == 1, (
+            "quarantine released a block with a live mapper ref")
+        assert bytes(mv[:4]) == b"qqqq"      # bytes still intact
+        del mv
+        gc.collect()
+        time.sleep(1.1)          # requeue windows have a 1s floor
+        with store._lock:
+            store._sweep_quarantine()
+        assert store.stats()["arena_quarantined_blocks"] == 0
+        reader.close()
+    finally:
+        CONFIG._values["arena_free_quarantine_s"] = old
+        store.shutdown()
